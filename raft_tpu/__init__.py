@@ -1,0 +1,22 @@
+"""raft-tpu: a TPU-native (JAX/XLA) frequency-domain floating wind turbine
+dynamics framework with the capabilities of WISDEM/RAFT.
+
+The public API mirrors the reference package root
+(/root/reference/raft/__init__.py): ``Model`` is the main entry point.
+"""
+
+__version__ = "0.1.0"
+
+from .schema import get_from_dict  # noqa: F401
+
+
+def __getattr__(name):
+    # Lazy import so that `import raft_tpu` stays cheap and so ops-level
+    # test environments don't pay for the full model stack.
+    if name == "Model":
+        try:
+            from .core.model import Model
+        except ImportError as e:
+            raise AttributeError(f"raft_tpu.Model unavailable: {e}") from e
+        return Model
+    raise AttributeError(name)

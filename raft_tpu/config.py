@@ -1,0 +1,27 @@
+"""Global numeric configuration for raft-tpu.
+
+The physics core runs in float64 when validating against the reference
+golden values (rtol ~1e-5; see /root/reference/tests/*), and in float32
+(with bfloat16 matmuls where safe) for TPU throughput runs.  TPUs do not
+have native f64 ALUs, so x64 is reserved for CPU-backend verification.
+"""
+
+import jax
+
+# Water/air defaults mirroring the reference's Env stub (helpers.py:9-18).
+RHO_WATER = 1025.0
+RHO_AIR = 1.225
+GRAVITY = 9.81
+
+
+def enable_x64() -> None:
+    """Enable double precision globally (the verification suite does this
+    via tests/conftest.py)."""
+    jax.config.update("jax_enable_x64", True)
+
+
+def force_cpu() -> None:
+    """Force the CPU backend even when a TPU plugin latched the platform
+    choice at interpreter start (see tests/conftest.py for why env vars
+    are not enough in this environment)."""
+    jax.config.update("jax_platforms", "cpu")

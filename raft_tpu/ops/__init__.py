@@ -1,0 +1,3 @@
+"""Vectorized math kernels (frame transforms, wave kinematics, geometry)."""
+
+from . import frustum, transforms, waves  # noqa: F401

@@ -1,0 +1,129 @@
+"""Closed-form frustum volume / centroid / moment-of-inertia kernels.
+
+JAX ports of the geometric primitives the reference uses for member mass
+and buoyancy rollups (helpers.FrustumVCV at helpers.py:36-63 and the
+FrustumMOI/RectangularFrustumMOI closures inside Member.getInertia,
+raft_member.py:321-402).  All kernels broadcast over leading batch
+dimensions and use ``where`` guards instead of Python branches so whole
+member node-arrays can be evaluated in a single fused expression.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def frustum_vcv_circ(dA, dB, H):
+    """Volume and centroid height of a circular (tapered) frustum."""
+    dA, dB, H = jnp.asarray(dA), jnp.asarray(dB), jnp.asarray(H)
+    A1 = (jnp.pi / 4) * dA**2
+    A2 = (jnp.pi / 4) * dB**2
+    Amid = (jnp.pi / 4) * dA * dB
+    denom = A1 + Amid + A2
+    V = denom * H / 3.0
+    hc = jnp.where(denom > 0, (A1 + 2 * Amid + 3 * A2) / jnp.where(denom > 0, denom, 1.0) * H / 4.0, 0.0)
+    return V, hc
+
+
+def frustum_vcv_rect(slA, slB, H):
+    """Volume and centroid height of a rectangular frustum.
+
+    ``slA``/``slB`` are [..., 2] side-length pairs at each end.
+    """
+    slA, slB = jnp.asarray(slA), jnp.asarray(slB)
+    H = jnp.asarray(H)
+    A1 = slA[..., 0] * slA[..., 1]
+    A2 = slB[..., 0] * slB[..., 1]
+    Amid = jnp.sqrt(A1 * A2)
+    denom = A1 + Amid + A2
+    V = denom * H / 3.0
+    hc = jnp.where(denom > 0, (A1 + 2 * Amid + 3 * A2) / jnp.where(denom > 0, denom, 1.0) * H / 4.0, 0.0)
+    return V, hc
+
+
+def frustum_moi_circ(dA, dB, H, rho):
+    """Radial (about end node) and axial MoI of a solid circular frustum.
+
+    Matches the cylinder/taper branches of the reference's FrustumMOI
+    (raft_member.py:321-339); degenerate H=0 gives zeros.
+    """
+    dA, dB, H = jnp.asarray(dA), jnp.asarray(dB), jnp.asarray(H)
+    r1 = dA / 2.0
+    r2 = dB / 2.0
+    is_cyl = jnp.abs(dA - dB) == 0
+    # cylinder closed forms
+    I_rad_cyl = (1.0 / 12.0) * (rho * H * jnp.pi * r1**2) * (3 * r1**2 + 4 * H**2)
+    I_ax_cyl = 0.5 * rho * jnp.pi * H * r1**4
+    # tapered frustum closed forms (guard the r2-r1 division)
+    dr = jnp.where(is_cyl, 1.0, r2 - r1)
+    I_rad_tap = (1.0 / 20.0) * rho * jnp.pi * H * (r2**5 - r1**5) / dr + (
+        1.0 / 30.0
+    ) * rho * jnp.pi * H**3 * (r1**2 + 3 * r1 * r2 + 6 * r2**2)
+    I_ax_tap = (1.0 / 10.0) * rho * jnp.pi * H * (r2**5 - r1**5) / dr
+    I_rad = jnp.where(is_cyl, I_rad_cyl, I_rad_tap)
+    I_ax = jnp.where(is_cyl, I_ax_cyl, I_ax_tap)
+    zero = H == 0
+    return jnp.where(zero, 0.0, I_rad), jnp.where(zero, 0.0, I_ax)
+
+
+def frustum_moi_rect(slA, slB, H, rho):
+    """End-node MoIs (Ixx, Iyy, Izz) of a rectangular frustum.
+
+    Covers all four reference branches (cuboid, truncated pyramid, and
+    the two single-taper prisms; raft_member.py:341-402) via nested
+    ``where`` so it stays batchable.  ``slA``/``slB`` are [..., 2]
+    (length L along local x, width W along local y).
+    """
+    slA, slB = jnp.asarray(slA), jnp.asarray(slB)
+    H = jnp.asarray(H)
+    La, Wa = slA[..., 0], slA[..., 1]
+    Lb, Wb = slB[..., 0], slB[..., 1]
+
+    sameL = La == Lb
+    sameW = Wa == Wb
+
+    # cuboid
+    M = rho * La * Wa * H
+    Ixx_c = (1.0 / 12.0) * M * (Wa**2 + 4 * H**2)
+    Iyy_c = (1.0 / 12.0) * M * (La**2 + 4 * H**2)
+    Izz_c = (1.0 / 12.0) * M * (La**2 + Wa**2)
+
+    # full truncated pyramid (La!=Lb and Wa!=Wb)
+    x2_p = (1.0 / 12.0) * rho * (
+        (Lb - La) ** 3 * H * (Wb / 5 + Wa / 20)
+        + (Lb - La) ** 2 * La * H * (3 * Wb / 4 + Wa / 4)
+        + (Lb - La) * La**2 * H * (Wb + Wa / 2)
+        + La**3 * H * (Wb / 2 + Wa / 2)
+    )
+    y2_p = (1.0 / 12.0) * rho * (
+        (Wb - Wa) ** 3 * H * (Lb / 5 + La / 20)
+        + (Wb - Wa) ** 2 * Wa * H * (3 * Lb / 4 + La / 4)
+        + (Wb - Wa) * Wa**2 * H * (Lb + La / 2)
+        + Wa**3 * H * (Lb / 2 + La / 2)
+    )
+    z2_p = rho * (Wb * Lb / 5 + Wa * Lb / 20 + La * Wb / 20 + Wa * La / 30.0) * H**3
+
+    # prism with equal lengths (La==Lb, widths taper)
+    x2_l = (1.0 / 24.0) * rho * (La**3) * H * (Wb + Wa)
+    y2_l = (1.0 / 48.0) * rho * La * H * (Wb**3 + Wa * Wb**2 + Wa**2 * Wb + Wa**3)
+    z2_l = (1.0 / 12.0) * rho * La * (H**3) * (3 * Wb + Wa)
+
+    # prism with equal widths (Wa==Wb, lengths taper)
+    x2_w = (1.0 / 48.0) * rho * Wa * H * (Lb**3 + La * Lb**2 + La**2 * Lb + La**3)
+    y2_w = (1.0 / 24.0) * rho * (Wa**3) * H * (Lb + La)
+    z2_w = (1.0 / 12.0) * rho * Wa * (H**3) * (3 * Lb + La)
+
+    x2 = jnp.where(sameL & sameW, 0.0, jnp.where(sameL, x2_l, jnp.where(sameW, x2_w, x2_p)))
+    y2 = jnp.where(sameL & sameW, 0.0, jnp.where(sameL, y2_l, jnp.where(sameW, y2_w, y2_p)))
+    z2 = jnp.where(sameL & sameW, 0.0, jnp.where(sameL, z2_l, jnp.where(sameW, z2_w, z2_p)))
+
+    Ixx = jnp.where(sameL & sameW, Ixx_c, y2 + z2)
+    Iyy = jnp.where(sameL & sameW, Iyy_c, x2 + z2)
+    Izz = jnp.where(sameL & sameW, Izz_c, x2 + y2)
+
+    zero = H == 0
+    return (
+        jnp.where(zero, 0.0, Ixx),
+        jnp.where(zero, 0.0, Iyy),
+        jnp.where(zero, 0.0, Izz),
+    )

@@ -1,0 +1,190 @@
+"""Rigid-body frame transformation kernels.
+
+Pure JAX re-derivations of the reference's frame math
+(/root/reference/raft/helpers.py:314-579 and
+moorpy.helpers.transformPosition), written batch-first: every function
+accepts arbitrary leading batch dimensions and is safe to ``vmap``/``jit``.
+The 6-DOF convention matches the reference: [surge sway heave roll pitch
+yaw] about a platform reference point (PRP), rotations as small angles
+where noted.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+
+def rotation_matrix(rpy):
+    """Intrinsic z-y-x (yaw-pitch-roll applied to rotated axes) DCM.
+
+    Matches helpers.rotationMatrix(x3, x2, x1) called as
+    ``rotationMatrix(*r6[3:])`` — input is ``[..., 3]`` (roll, pitch, yaw)
+    in radians; output ``[..., 3, 3]``.
+    """
+    rpy = jnp.asarray(rpy)
+    x3, x2, x1 = rpy[..., 0], rpy[..., 1], rpy[..., 2]
+    s1, c1 = jnp.sin(x1), jnp.cos(x1)
+    s2, c2 = jnp.sin(x2), jnp.cos(x2)
+    s3, c3 = jnp.sin(x3), jnp.cos(x3)
+    row0 = jnp.stack([c1 * c2, c1 * s2 * s3 - c3 * s1, s1 * s3 + c1 * c3 * s2], axis=-1)
+    row1 = jnp.stack([c2 * s1, c1 * c3 + s1 * s2 * s3, c3 * s1 * s2 - c1 * s3], axis=-1)
+    row2 = jnp.stack([-s2, c2 * s3, c2 * c3], axis=-1)
+    return jnp.stack([row0, row1, row2], axis=-2)
+
+
+def small_rotate(r, th):
+    """First-order displacement of point ``r`` under small rotations ``th``.
+
+    (helpers.SmallRotate; helpers.py:314-326).  Broadcasts over leading
+    dims; supports complex ``th`` (used with response amplitudes).
+    """
+    r = jnp.asarray(r)
+    th = jnp.asarray(th)
+    x = -th[..., 2] * r[..., 1] + th[..., 1] * r[..., 2]
+    y = th[..., 2] * r[..., 0] - th[..., 0] * r[..., 2]
+    z = -th[..., 1] * r[..., 0] + th[..., 0] * r[..., 1]
+    return jnp.stack([x, y, z], axis=-1)
+
+
+def outer3(vec):
+    """vec · vecᵀ for ``[..., 3]`` vectors (helpers.VecVecTrans)."""
+    vec = jnp.asarray(vec)
+    return vec[..., :, None] * vec[..., None, :]
+
+
+def alternator(r):
+    """Alternator (cross-product) matrix H of a size-3 vector (helpers.getH).
+
+    ``H @ v == cross(r, v)``... note the reference's H is constructed such
+    that ``matmul(H, v) = cross(r, v)`` with H asymmetric as written at
+    helpers.py:346-355.
+    """
+    r = jnp.asarray(r)
+    z = jnp.zeros_like(r[..., 0])
+    row0 = jnp.stack([z, r[..., 2], -r[..., 1]], axis=-1)
+    row1 = jnp.stack([-r[..., 2], z, r[..., 0]], axis=-1)
+    row2 = jnp.stack([r[..., 1], -r[..., 0], z], axis=-1)
+    return jnp.stack([row0, row1, row2], axis=-2)
+
+
+def translate_force_3to6(F, r):
+    """Force at point ``r`` → 6-DOF force/moment about origin.
+
+    (helpers.translateForce3to6DOF).  ``F``: [..., 3]; ``r``: [..., 3];
+    returns [..., 6] (complex-safe).
+    """
+    F = jnp.asarray(F)
+    r = jnp.asarray(r)
+    return jnp.concatenate([F, jnp.cross(r, F)], axis=-1)
+
+
+def translate_matrix_3to6(M, r):
+    """3x3 mass-like matrix at point ``r`` → 6x6 about origin.
+
+    (helpers.translateMatrix3to6DOF, after Sadeghi & Incecik.)
+    """
+    M = jnp.asarray(M)
+    H = alternator(r)
+    MH = M @ H
+    top = jnp.concatenate([M, MH], axis=-1)
+    bottom = jnp.concatenate([jnp.swapaxes(MH, -1, -2), H @ M @ jnp.swapaxes(H, -1, -2)], axis=-1)
+    return jnp.concatenate([top, bottom], axis=-2)
+
+
+def translate_matrix_6to6(M, r):
+    """Translate a 6x6 mass/inertia matrix to a new reference point.
+
+    (helpers.translateMatrix6to6DOF) ``r`` points from the new reference
+    point to the current one.
+    """
+    M = jnp.asarray(M)
+    H = alternator(r)
+    Ht = jnp.swapaxes(H, -1, -2)
+    m = M[..., :3, :3]
+    J = M[..., :3, 3:]
+    I = M[..., 3:, 3:]
+    mH = m @ H
+    Jp = mH + J
+    Ip = H @ m @ Ht + M[..., 3:, :3] @ H + Ht @ J + I
+    top = jnp.concatenate([m, Jp], axis=-1)
+    bottom = jnp.concatenate([jnp.swapaxes(Jp, -1, -2), Ip], axis=-1)
+    return jnp.concatenate([top, bottom], axis=-2)
+
+
+def rotate_matrix3(M, R):
+    """[m'] = [R][m][R]^T (helpers.rotateMatrix3)."""
+    return R @ M @ jnp.swapaxes(R, -1, -2)
+
+
+def rotate_matrix6(M, R):
+    """Rotate a 6x6 tensor by DCM ``R`` blockwise (helpers.rotateMatrix6)."""
+    m = rotate_matrix3(M[..., :3, :3], R)
+    J = rotate_matrix3(M[..., :3, 3:], R)
+    I = rotate_matrix3(M[..., 3:, 3:], R)
+    top = jnp.concatenate([m, J], axis=-1)
+    bottom = jnp.concatenate([jnp.swapaxes(J, -1, -2), I], axis=-1)
+    return jnp.concatenate([top, bottom], axis=-2)
+
+
+def rot_from_vectors(A, B, eps=0.0):
+    """Rodrigues rotation taking unit direction A to B (helpers.RotFrm2Vect).
+
+    Falls back to identity when A ∥ B (mirrors the reference's behavior).
+    """
+    A = A / jnp.linalg.norm(A, axis=-1, keepdims=True)
+    B = B / jnp.linalg.norm(B, axis=-1, keepdims=True)
+    v = jnp.cross(A, B)
+    v2 = jnp.sum(v * v, axis=-1)
+    ssc = -alternator(v)  # skew matrix with ssc @ x = cross(v, x)
+    dotAB = jnp.sum(A * B, axis=-1)
+    eye = jnp.broadcast_to(jnp.eye(3, dtype=A.dtype), ssc.shape)
+    safe_v2 = jnp.where(v2 == 0, 1.0, v2)
+    R = eye + ssc + (ssc @ ssc) * ((1.0 - dotAB) / safe_v2)[..., None, None]
+    return jnp.where((v2 == 0)[..., None, None], eye, R)
+
+
+def transform_position(r_rel, r6):
+    """Position of a body-fixed point after body displacement ``r6``.
+
+    Matches moorpy.helpers.transformPosition as used by the reference at
+    raft_member.py:287-288: rotate by the platform DCM then translate.
+    """
+    r_rel = jnp.asarray(r_rel)
+    r6 = jnp.asarray(r6)
+    R = rotation_matrix(r6[..., 3:])
+    return jnp.einsum("...ij,...j->...i", R, r_rel) + r6[..., :3]
+
+
+def transform_force(f_in, offset=None, orientation=None):
+    """Transform a size-3/6 force between frames (helpers.transformForce).
+
+    ``orientation`` must be exactly shape (3,) (z-y-x Euler angles) or
+    (3, 3) (DCM), mirroring the reference's accepted inputs — anything
+    else is ambiguous (a batch of three Euler triples is shaped like one
+    DCM) and raises.  For batched rotations, build DCMs explicitly with
+    :func:`rotation_matrix` and apply them with einsum.
+    """
+    f_in = jnp.asarray(f_in)
+    if f_in.shape[-1] == 3:
+        f = jnp.concatenate([f_in, jnp.zeros_like(f_in)], axis=-1)
+    elif f_in.shape[-1] == 6:
+        f = f_in
+    else:
+        raise ValueError("f_in input must be size 3 or 6")
+    if orientation is not None:
+        R = jnp.asarray(orientation)
+        if R.shape == (3,):
+            R = rotation_matrix(R)
+        elif R.shape != (3, 3):
+            raise ValueError("orientation input if provided must be size 3 or 3-by-3")
+        f = jnp.concatenate(
+            [
+                jnp.einsum("...ij,...j->...i", R, f[..., :3]),
+                jnp.einsum("...ij,...j->...i", R, f[..., 3:]),
+            ],
+            axis=-1,
+        )
+    if offset is not None:
+        offset = jnp.asarray(offset)
+        f = f.at[..., 3:].add(jnp.cross(offset, f[..., :3]))
+    return f

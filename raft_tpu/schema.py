@@ -1,0 +1,86 @@
+"""Design-dictionary (YAML) access helpers.
+
+Host-side utilities that reproduce the reference's config semantics —
+notably ``getFromDict`` (helpers.py:697-775), whose scalar→array tiling,
+shape validation, per-rotor indexing, and required-key errors define how
+every RAFT YAML field is interpreted.  These run on the host during
+"design compilation" (YAML → padded pytrees); nothing here is traced.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def get_from_dict(d, key, shape=0, dtype=float, default=None, index=None):
+    """Fetch ``key`` from design dict ``d`` with RAFT's shape semantics.
+
+    shape=0: scalar expected; shape=-1: passthrough (scalar or array);
+    shape=n: 1-D array of length n (scalars are tiled, ``index`` selects a
+    column of 2-D input); shape=[m, n]: 2-D array (1-D rows are tiled m
+    times).  Missing keys raise unless ``default`` is given.
+    """
+    if key in d:
+        val = d[key]
+        if shape == 0:
+            if np.isscalar(val):
+                return dtype(val)
+            raise ValueError(f"Value for key '{key}' is expected to be a scalar but instead is: {val}")
+        elif shape == -1:
+            if np.isscalar(val):
+                return dtype(val)
+            return np.array(val, dtype=dtype)
+        else:
+            if np.isscalar(val):
+                return np.tile(dtype(val), shape)
+            elif np.isscalar(shape):
+                if len(val) == shape:
+                    if index is None:
+                        return np.array([dtype(v) for v in val])
+                    keyshape = np.array(val).shape
+                    if len(keyshape) == 1:
+                        if index in range(keyshape[0]):
+                            return np.tile(val[index], shape)
+                        raise ValueError(
+                            f"Value for index '{index}' is not within the size of {val} (len={keyshape[0]})"
+                        )
+                    else:
+                        if index in range(keyshape[1]):
+                            return np.array([v[index] for v in val])
+                        raise ValueError(
+                            f"Value for index '{index}' is not within the size of {val} (len={keyshape[0]})"
+                        )
+                else:
+                    raise ValueError(
+                        f"Value for key '{key}' is not the expected size of {shape} and is instead: {val}"
+                    )
+            else:
+                vala = np.array(val, dtype=dtype)
+                if list(vala.shape) == list(shape):
+                    return vala
+                elif len(shape) > 2:
+                    raise ValueError("get_from_dict isn't set up for shapes larger than 2 dimensions")
+                elif vala.ndim == 1 and len(vala) == shape[1]:
+                    return np.tile(vala, [shape[0], 1])
+                else:
+                    raise ValueError(
+                        f"Value for key '{key}' is not a compatible size for target size of {shape} and is instead: {val}"
+                    )
+    else:
+        if default is None:
+            raise ValueError(f"Key '{key}' not found in input file...")
+        if shape == 0 or shape == -1:
+            return default
+        if np.isscalar(default):
+            return np.tile(default, shape)
+        return np.tile(default, [shape, 1])
+
+
+def load_design(path_or_dict):
+    """Load a RAFT design YAML (or pass through an already-parsed dict)."""
+    if isinstance(path_or_dict, dict):
+        return path_or_dict
+    import yaml
+
+    with open(path_or_dict) as f:
+        return yaml.load(f, Loader=yaml.FullLoader)

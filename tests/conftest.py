@@ -1,0 +1,36 @@
+"""Test configuration: run JAX on a virtual 8-device CPU mesh in float64.
+
+Multi-chip sharding is validated on the host platform via
+``--xla_force_host_platform_device_count`` (no TPU pod is needed), and
+float64 is enabled so results can be compared against the reference
+golden values at rtol≈1e-5 (see /root/reference/tests/*).
+
+Note: this environment pre-registers a TPU PJRT plugin in every Python
+process (sitecustomize on PYTHONPATH) and latches JAX_PLATFORMS at that
+import, so the platform must be forced back to ``cpu`` through
+``jax.config`` here — plain env vars are read too early to help.
+"""
+
+import os
+
+flags = os.environ.get("XLA_FLAGS", "")
+if "xla_force_host_platform_device_count" not in flags:
+    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+
+from raft_tpu import config as _config  # noqa: E402
+
+_config.force_cpu()
+_config.enable_x64()
+
+import pytest  # noqa: E402
+
+REFERENCE_DIR = "/root/reference"
+REFERENCE_TEST_DATA = os.path.join(REFERENCE_DIR, "tests", "test_data")
+
+
+@pytest.fixture(scope="session")
+def ref_test_data():
+    """Path to the reference implementation's golden test data, if present."""
+    if not os.path.isdir(REFERENCE_TEST_DATA):
+        pytest.skip("reference golden data not available")
+    return REFERENCE_TEST_DATA

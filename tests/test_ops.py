@@ -1,0 +1,341 @@
+"""Math-kernel parity tests.
+
+Golden values mirror the reference's unit test corpus
+(/root/reference/tests/test_helpers.py) so the JAX kernels can be checked
+for exact numerical parity (rtol 1e-5) with the original NumPy routines.
+"""
+
+import numpy as np
+import pytest
+from numpy.testing import assert_allclose
+
+from raft_tpu.ops import frustum, transforms, waves
+from raft_tpu.schema import get_from_dict
+
+
+def test_frustum_vcv():
+    # circular (test_helpers.py:14-18)
+    V, hc = frustum.frustum_vcv_circ(2.0, 1.0, 2.0)
+    assert_allclose([V, hc], [3.665191429188092, 0.7857142857142856], rtol=1e-05)
+    # rectangular (test_helpers.py:20-23)
+    V, hc = frustum.frustum_vcv_rect([2.0, 1.0], [1.0, 0.5], 2.0)
+    assert_allclose([V, hc], [2.3333333333333335, 0.7857142857142857], rtol=1e-05)
+    # degenerate
+    V, hc = frustum.frustum_vcv_circ(0.0, 0.0, 2.0)
+    assert_allclose([V, hc], [0.0, 0.0])
+
+
+def test_kinematics_from_modes():
+    # test_helpers.py:26-38
+    r = np.array([2.0, 2.0, 2.0])
+    w = np.array([0.5, 0.75])
+    Xi = np.array(
+        [
+            [1, 2 + 1j],
+            [0.1 + 0.2j, 0.3 + 0.4j],
+            [0.5 + 0.6j, 0.7 + 0.8j],
+            [0.9 + 1.0j, 1.1 + 1.2j],
+            [1.3 + 1.4j, 1.5 + 1.6j],
+            [1.7 + 1.8j, 1.9 + 2.0j],
+        ]
+    )
+    desired = np.array(
+        [
+            [
+                [0.2 - 8.00000000e-01j, 1.2 + 2.00000000e-01j],
+                [1.7 + 1.80000000e00j, 1.9 + 2.00000000e00j],
+                [-0.3 - 2.00000000e-01j, -0.1 - 2.22044605e-16j],
+            ],
+            [
+                [4.00000000e-01 + 0.1j, -1.50000000e-01 + 0.9j],
+                [-9.00000000e-01 + 0.85j, -1.50000000e00 + 1.425j],
+                [1.00000000e-01 - 0.15j, 1.66533454e-16 - 0.075j],
+            ],
+            [
+                [-0.05 + 2.0000000e-01j, -0.675 - 1.1250000e-01j],
+                [-0.425 - 4.5000000e-01j, -1.06875 - 1.1250000e00j],
+                [0.075 + 5.0000000e-02j, 0.05625 + 1.2490009e-16j],
+            ],
+        ]
+    )
+    dr, v, a = waves.kinematics_from_modes(r, Xi, w)
+    assert_allclose(np.array([dr, v, a]), desired, rtol=1e-05, atol=1e-12)
+
+
+def test_wave_number_and_kinematics():
+    # test_helpers.py:41-69
+    w = np.array([0.1, 0.25, 0.5, 0.75])
+    zeta0 = np.full(4, 0.2)
+    beta, h = 30.0, 200.0
+    r = np.array([30.0, 45.0, -20.0])
+
+    k = waves.wave_number(w, h)
+    assert_allclose(k, [0.00233623, 0.0071452, 0.02548611, 0.05733945], rtol=1e-05)
+
+    desired_u = np.array(
+        [
+            [0.00690971 + 0.00064489j, 0.00732697 + 0.00214361j, 0.00488759 + 0.00787284j, -0.00480898 + 0.00555819j],
+            [-0.04425901 - 0.00413072j, -0.04693167 - 0.01373052j, -0.03130665 - 0.05042812j, 0.03080313 - 0.03560204j],
+            [-0.00166131 + 0.01780023j, -0.01192503 + 0.04076042j, -0.05102840 + 0.03167931j, -0.03603330 - 0.03117625j],
+        ]
+    )
+    desired_pDyn = np.array(
+        [
+            1963.730340920 + 183.276331860j,
+            1703.156386190 + 498.282218140j,
+            637.171137130 + 1026.342526750j,
+            -417.980049950 + 483.098446900j,
+        ]
+    )
+    u, ud, pDyn = waves.wave_kinematics(zeta0, beta, w, k, h, r)
+    assert_allclose(u, desired_u, rtol=1e-05, atol=1e-9)
+    assert_allclose(ud, 1j * w * desired_u, rtol=1e-05, atol=1e-9)
+    assert_allclose(pDyn, desired_pDyn, rtol=1e-05)
+
+    # dry node gives zeros
+    u2, ud2, p2 = waves.wave_kinematics(zeta0, beta, w, k, h, np.array([0.0, 0.0, 5.0]))
+    assert_allclose(np.abs(u2), 0.0)
+    assert_allclose(np.abs(p2), 0.0)
+
+    # batched nodes: stack wet+dry and confirm rows match the single-node runs
+    rr = np.stack([r, np.array([0.0, 0.0, 5.0])])
+    ub, _, pb = waves.wave_kinematics(zeta0, beta, w, k, h, rr)
+    assert ub.shape == (2, 3, 4)
+    assert_allclose(ub[0], u, rtol=1e-12)
+    assert_allclose(pb[1], 0.0)
+
+
+def test_wave_kinematics_f32_grad_finite():
+    # deep-water nodes (kh >> 89.4) must not poison f32 gradients via the
+    # masked shallow-water branch (inf/inf = NaN under grad-of-where)
+    import jax
+    import jax.numpy as jnp
+
+    w = jnp.asarray([1.5], dtype=jnp.float32)
+    k = jnp.asarray([0.3], dtype=jnp.float32)  # k*h = 300 with h=1000
+    zeta0 = jnp.asarray([1.0], dtype=jnp.complex64)
+
+    def p_at_depth(z):
+        r = jnp.stack([jnp.float32(0.0), jnp.float32(0.0), z])
+        _, _, p = waves.wave_kinematics(zeta0, 0.0, w, k, jnp.float32(1000.0), r)
+        return jnp.real(p)[0]
+
+    g = jax.grad(p_at_depth)(jnp.float32(-5.0))
+    assert np.isfinite(float(g))
+
+
+def test_transform_force_rejects_ambiguous_orientation():
+    F = np.zeros(3)
+    with pytest.raises(ValueError):
+        transforms.transform_force(np.zeros(4))
+    with pytest.raises(ValueError):
+        transforms.transform_force(F, orientation=np.zeros((2, 3)))
+
+
+def test_small_rotate():
+    # test_helpers.py:72-77
+    r = np.array([1.0, 2.0, 3.0])
+    th = np.array([5 + 3j, 3 + 5j, 4 + 3j]) * (np.pi / 180.0)
+    rt = transforms.small_rotate(r, th)
+    desired = np.array([0.01745329 + 0.15707963j, -0.19198622 - 0.10471976j, 0.12217305 + 0.01745329j])
+    assert_allclose(rt, desired, rtol=1e-05)
+
+
+def test_outer3():
+    # test_helpers.py:80-85
+    v = np.array([0.7 + 1.2j, 1.5 + 0.4j, 3.0 + 2.3j])
+    desired = np.array(
+        [
+            [-0.95 + 1.68j, 0.57 + 2.08j, -0.66 + 5.21j],
+            [0.57 + 2.08j, 2.09 + 1.2j, 3.58 + 4.65j],
+            [-0.66 + 5.21j, 3.58 + 4.65j, 3.71 + 13.8j],
+        ]
+    )
+    assert_allclose(transforms.outer3(v), desired, rtol=1e-05)
+
+
+def test_translate_force_3to6():
+    # test_helpers.py:88-94
+    Fin = np.array([0.5 + 3j, 2.0 + 1.5j, 3.0 + 0.7j])
+    r = np.array([1.0, 2.0, 3.0])
+    desired = np.array([0.5 + 3.0j, 2.0 + 1.5j, 3.0 + 0.7j, 0.0 - 3.1j, -1.5 + 8.3j, 1.0 - 4.5j])
+    assert_allclose(transforms.translate_force_3to6(Fin, r), desired, rtol=1e-05)
+
+
+def test_transform_force():
+    # test_helpers.py:97-120
+    offset = np.array([10.0, 20.0, 30.0])
+    f_in = np.array([0.5 + 3j, 2.0 + 1.5j, 3.0 + 0.7j])
+    F_in = np.array([1.2 + 0.3j, 0.4 + 1.5j, 2.3 + 0.7j, 0.5 + 0.9j, 1.1 + 0.2j, 0.7 + 1.4j])
+    orient_3 = np.array([0.1, 0.2, 0.3])
+    rotMat = transforms.rotation_matrix(orient_3)
+
+    desired = np.array(
+        [
+            0.57300698 + 2.54908178j,
+            1.94679387 + 2.27765615j,
+            3.02186311 + 0.23337633j,
+            2.03344603 - 63.66215798j,
+            -13.02842176 + 74.13869023j,
+            8.00779917 - 28.20507416j,
+        ]
+    )
+    assert_allclose(transforms.transform_force(f_in, offset=offset, orientation=orient_3), desired, rtol=1e-05)
+    assert_allclose(transforms.transform_force(f_in, offset=offset, orientation=rotMat), desired, rtol=1e-05)
+
+    desired6 = np.array(
+        [
+            1.51572022 + 2.10897023e-02j,
+            0.64512428 + 1.49565656e00j,
+            2.04362591 + 7.69783522e-01j,
+            21.83717669 - 2.83806906e01j,
+            26.20635997 - 6.66493243e00j,
+            -23.17224939 + 1.57407763e01j,
+        ]
+    )
+    assert_allclose(transforms.transform_force(F_in, offset=offset, orientation=orient_3), desired6, rtol=1e-05)
+    assert_allclose(transforms.transform_force(F_in, offset=offset, orientation=rotMat), desired6, rtol=1e-05)
+
+
+def test_translate_matrix_3to6():
+    # test_helpers.py:123-136
+    Min = np.array([[0.73, 2.41, 3.88], [1.25, 9.12, 5.79], [5.37, 7.94, 8.63]])
+    r = np.array([10.0, 20.0, 30.0])
+    desired = np.array(
+        [
+            [7.300e-01, 2.410e00, 3.880e00, 5.300e00, -1.690e01, 9.500e00],
+            [1.250e00, 9.120e00, 5.790e00, -1.578e02, -2.040e01, 6.620e01],
+            [5.370e00, 7.940e00, 8.630e00, -6.560e01, 7.480e01, -2.800e01],
+            [5.300e00, -1.578e02, -6.560e01, 3.422e03, 2.108e03, -2.546e03],
+            [-1.690e01, -2.040e01, 7.480e01, 8.150e02, -1.255e03, 5.650e02],
+            [9.500e00, 6.620e01, -2.800e01, -1.684e03, 1.340e02, 4.720e02],
+        ]
+    )
+    assert_allclose(transforms.translate_matrix_3to6(Min, r), desired, rtol=1e-05)
+
+
+def test_translate_matrix_6to6():
+    # test_helpers.py:139-155
+    Min = np.array(
+        [
+            [0.57, 0.64, 0.88, 0.12, 0.34, 0.56],
+            [2.03, -13.02, 8.00, 0.78, 0.90, 0.12],
+            [1.11, -0.15, 0.10, 0.34, 0.56, 0.78],
+            [0.12, 0.78, 0.34, 0.90, 0.12, 0.34],
+            [0.34, 0.90, 0.56, 0.12, 0.34, 0.56],
+            [0.56, 0.12, 0.78, 0.34, 0.56, 0.78],
+        ]
+    )
+    r = np.array([10.0, 20.0, 30.0])
+    desired = np.array(
+        [
+            [5.70000e-01, 6.40000e-01, 8.80000e-01, -1.48000e00, 8.64000e00, -4.44000e00],
+            [2.03000e00, -1.30200e01, 8.00000e00, 5.51380e02, -1.82000e01, -1.70680e02],
+            [1.11000e00, -1.50000e-01, 1.00000e-01, 6.84000e00, 3.28600e01, -2.29200e01],
+            [-1.48000e00, 5.51380e02, 6.84000e00, -1.64203e04, 1.20352e03, 4.66774e03],
+            [8.64000e00, -1.82000e01, 3.28600e01, -1.28480e02, -6.44600e01, 9.87600e01],
+            [-4.44000e00, -1.70680e02, -2.29200e01, 5.55574e03, -3.45240e02, -1.62722e03],
+        ]
+    )
+    assert_allclose(transforms.translate_matrix_6to6(Min, r), desired, rtol=1e-05)
+
+
+def test_rotate_matrix6():
+    # test_helpers.py:158-175
+    rotMat = transforms.rotation_matrix(np.array([0.1, 0.2, 0.3]))
+    Min = np.array(
+        [
+            [0.57, 0.64, 0.88, 0.12, 0.34, 0.56],
+            [2.03, -13.02, 8.00, 0.78, 0.90, 0.12],
+            [1.11, -0.15, 0.10, 0.34, 0.56, 0.78],
+            [0.12, 0.78, 0.34, 0.90, 0.12, 0.34],
+            [0.34, 0.90, 0.56, 0.12, 0.34, 0.56],
+            [0.56, 0.12, 0.78, 0.34, 0.56, 0.78],
+        ]
+    )
+    desired = np.array(
+        [
+            [-1.23327412, 4.08056795, -0.95870608, 0.06516703, 0.15206293, 0.66964386],
+            [7.03270577, -11.42123791, 6.09625616, 0.51524892, 1.11098643, 0.18118973],
+            [1.67312218, -1.16775529, 0.30451203, 0.34805446, 0.62871201, 0.62384654],
+            [0.06516703, 0.51524892, 0.34805446, 0.86182628, 0.37858592, 0.16449501],
+            [0.15206293, 1.11098643, 0.62871201, 0.37858592, 0.40719201, 0.55131878],
+            [0.66964386, 0.18118973, 0.62384654, 0.16449501, 0.55131878, 0.75098172],
+        ]
+    )
+    assert_allclose(transforms.rotate_matrix6(Min, rotMat), desired, rtol=1e-05)
+
+
+def test_rot_from_vectors():
+    # test_helpers.py:194-200
+    rotMat = transforms.rotation_matrix(np.array([0.1, 0.2, 0.3]))
+    A = np.array([5.0, 0.0, 0.0])
+    B = rotMat @ A
+    R = transforms.rot_from_vectors(A, B)
+    assert_allclose(B, R @ A, rtol=1e-05)
+    # parallel vectors → identity
+    assert_allclose(transforms.rot_from_vectors(A, A), np.eye(3), atol=1e-12)
+
+
+def test_jonswap_matches_reference_formula():
+    ws = np.linspace(0.03, 2.5, 100)
+    Hs, Tp = 6.0, 12.0
+    # reference implementation transcribed in NumPy (helpers.JONSWAP)
+    TpOvrSqrtHs = Tp / np.sqrt(Hs)
+    if TpOvrSqrtHs <= 3.6:
+        Gamma = 5.0
+    elif TpOvrSqrtHs >= 5.0:
+        Gamma = 1.0
+    else:
+        Gamma = np.exp(5.75 - 1.15 * TpOvrSqrtHs)
+    f = 0.5 / np.pi * ws
+    fpOvrf4 = (Tp * f) ** -4.0
+    C = 1.0 - 0.287 * np.log(Gamma)
+    Sigma = 0.07 * (f <= 1.0 / Tp) + 0.09 * (f > 1.0 / Tp)
+    Alpha = np.exp(-0.5 * ((f * Tp - 1.0) / Sigma) ** 2)
+    S_ref = 0.5 / np.pi * C * 0.3125 * Hs * Hs * fpOvrf4 / f * np.exp(-1.25 * fpOvrf4) * Gamma**Alpha
+
+    assert_allclose(waves.jonswap(ws, Hs, Tp), S_ref, rtol=1e-10)
+    assert_allclose(waves.jonswap(ws, Hs, Tp, gamma=0), S_ref, rtol=1e-10)
+    # explicit gamma (low-frequency tail underflows to exactly 0, as in the
+    # reference formula — just require non-negative & finite)
+    S1 = np.asarray(waves.jonswap(ws, Hs, Tp, gamma=1.0))
+    assert np.all(S1 >= 0) and np.all(np.isfinite(S1))
+
+
+def test_psd_rms_rao():
+    rng = np.random.default_rng(0)
+    xi = rng.normal(size=(3, 8)) + 1j * rng.normal(size=(3, 8))
+    dw = 0.05
+    assert_allclose(waves.rms(xi), np.sqrt(0.5 * np.sum(np.abs(xi) ** 2)), rtol=1e-12)
+    assert_allclose(waves.psd(xi, dw), np.sum(0.5 * np.abs(xi) ** 2 / dw, axis=0), rtol=1e-12)
+    zeta = np.array([0.0, 1.0, 2.0, 1e-8, 4.0, 5.0, 6.0, 7.0])
+    r = waves.rao(xi, zeta)
+    assert_allclose(np.asarray(r)[:, 0], 0.0)
+    assert_allclose(np.asarray(r)[:, 2], xi[:, 2] / 2.0, rtol=1e-12)
+
+
+def test_get_from_dict():
+    d = {"a": 1.0, "b": [1.0, 2.0, 3.0], "c": [[1.0, 2.0], [3.0, 4.0], [5.0, 6.0]]}
+    assert get_from_dict(d, "a") == 1.0
+    assert_allclose(get_from_dict(d, "a", shape=3), [1.0, 1.0, 1.0])
+    assert_allclose(get_from_dict(d, "b", shape=3), [1.0, 2.0, 3.0])
+    assert_allclose(get_from_dict(d, "c", shape=3, index=0), [1.0, 3.0, 5.0])
+    assert_allclose(get_from_dict(d, "c", shape=[3, 2]), [[1, 2], [3, 4], [5, 6]])
+    assert_allclose(get_from_dict(d, "b", shape=[2, 3]), [[1, 2, 3], [1, 2, 3]])
+    assert get_from_dict(d, "missing", default=7.0) == 7.0
+    assert_allclose(get_from_dict(d, "missing", shape=2, default=7.0), [7.0, 7.0])
+    with pytest.raises(ValueError):
+        get_from_dict(d, "missing")
+    with pytest.raises(ValueError):
+        get_from_dict(d, "b", shape=4)
+
+
+def test_rotation_matrix_properties():
+    rpy = np.array([0.1, -0.2, 0.3])
+    R = np.asarray(transforms.rotation_matrix(rpy))
+    assert_allclose(R @ R.T, np.eye(3), atol=1e-12)
+    assert_allclose(np.linalg.det(R), 1.0, rtol=1e-12)
+    # yaw-only rotation about z
+    Rz = np.asarray(transforms.rotation_matrix(np.array([0.0, 0.0, np.pi / 2])))
+    assert_allclose(Rz @ np.array([1.0, 0, 0]), np.array([0, 1.0, 0]), atol=1e-12)
