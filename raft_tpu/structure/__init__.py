@@ -1,0 +1,3 @@
+"""Structural components: members (strip theory), rotors, towers."""
+
+from . import member  # noqa: F401

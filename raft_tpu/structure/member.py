@@ -1,0 +1,936 @@
+"""Strip-theory member: design compilation and batched physics kernels.
+
+Re-design of the reference Member class (/root/reference/raft/raft_member.py)
+for a TPU execution model.  The reference mutates per-node NumPy arrays in
+Python loops; here a member is split into
+
+- a **static topology** (station/segment counts, node layout, cap branch
+  choices, cross-section shape) fixed at design-compile time, and
+- a **geometry pytree** of jnp arrays (station positions, diameters,
+  thicknesses, ballast, drag/added-mass coefficient tables)
+
+so that every physics quantity — inertia matrix, hydrostatics, Morison
+added mass / excitation coefficients — is a pure jnp function of
+(topology, geometry, pose).  That makes the whole member layer
+differentiable and ``vmap``-able over design parameters (the sweep axis)
+and lets XLA fuse the node loops the reference runs in Python.
+
+Reference behavior parity targets: Member.__init__ station/strip setup
+(raft_member.py:67-220), setPosition (:245-304), getInertia (:307-707),
+getHydrostatics (:712-874), calcHydroConstants/calcImat/getCmSides
+(:877-1088).
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import GRAVITY, RHO_WATER
+from ..ops import frustum, transforms
+from ..schema import get_from_dict
+
+# ---------------------------------------------------------------------------
+# compiled member containers
+# ---------------------------------------------------------------------------
+
+
+@dataclasses.dataclass(frozen=True)
+class MemberTopology:
+    """Static (hashable) member structure resolved at compile time."""
+
+    shape: str  # 'circular' | 'rectangular'
+    n_st: int  # number of stations
+    seg_nodes: Tuple[int, ...]  # strip-node count per segment (0-len segs get 1)
+    seg_flat: Tuple[bool, ...]  # True where the segment has zero length
+    cap_kinds: Tuple[str, ...]  # per cap: 'bottom' | 'top' | 'mid'
+    pot_mod: bool
+    mcf: bool
+    type: int = 2
+    name: str = ""
+
+    @property
+    def n_nodes(self) -> int:
+        return 2 + sum(self.seg_nodes)
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MemberGeometry:
+    """Differentiable member description (all jnp arrays)."""
+
+    rA0: jnp.ndarray  # [3] end A rel. PRP (heading already applied)
+    rB0: jnp.ndarray  # [3]
+    gamma: jnp.ndarray  # [] twist about member axis [deg]
+    stations_frac: jnp.ndarray  # [n_st] along-axis positions as fractions 0..1
+    d: jnp.ndarray  # [n_st] diameters (circ) or [n_st,2] side lengths (rect)
+    t: jnp.ndarray  # [n_st] shell thickness
+    l_fill_frac: jnp.ndarray  # [n_st-1] ballast fill per segment as fraction of length
+    rho_fill: jnp.ndarray  # [n_st-1] ballast density per segment
+    rho_shell: jnp.ndarray  # [] shell density
+    Cd_q: jnp.ndarray  # [n_st]
+    Cd_p1: jnp.ndarray
+    Cd_p2: jnp.ndarray
+    Cd_end: jnp.ndarray
+    Ca_q: jnp.ndarray
+    Ca_p1: jnp.ndarray
+    Ca_p2: jnp.ndarray
+    Ca_end: jnp.ndarray
+    cap_stations_frac: jnp.ndarray  # [n_caps] along-axis position as fraction of length
+    cap_t: jnp.ndarray  # [n_caps]
+    cap_d_in: jnp.ndarray  # [n_caps] (circ) or [n_caps,2] (rect)
+
+
+@dataclasses.dataclass(frozen=True)
+class CompiledMember:
+    topo: MemberTopology
+    geom: MemberGeometry
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MemberPose:
+    """Member orientation/placement derived from the platform pose."""
+
+    R: jnp.ndarray  # [3,3] member DCM (global <- local)
+    q: jnp.ndarray  # [3] axial unit vector
+    p1: jnp.ndarray  # [3] transverse unit vector 1
+    p2: jnp.ndarray  # [3] transverse unit vector 2
+    rA: jnp.ndarray  # [3] displaced end A
+    rB: jnp.ndarray  # [3] displaced end B
+    r: jnp.ndarray  # [n_nodes,3] displaced node positions
+    ls: jnp.ndarray  # [n_nodes] along-axis node positions
+    dls: jnp.ndarray  # [n_nodes] lumped strip lengths
+    ds: jnp.ndarray  # [n_nodes] (+[,2] rect) strip diameters / side lengths
+    drs: jnp.ndarray  # [n_nodes] (+[,2] rect) strip radius change
+    l: jnp.ndarray  # [] member length
+
+
+# ---------------------------------------------------------------------------
+# host-side design compilation
+# ---------------------------------------------------------------------------
+
+
+def compile_member(mi: dict, heading: float = 0.0, dls_max_default: float = 5.0) -> CompiledMember:
+    """Parse one member description dict into (topology, geometry).
+
+    Mirrors the input semantics of Member.__init__ (raft_member.py:16-220):
+    station normalization to member length, heading rotation (with the
+    vertical-member twist special case), scalar→array tiling of
+    coefficients, ballast validation, and the dlsMax strip discretization
+    — except the *node layout* (how many strips each segment gets) is
+    frozen into the topology so downstream shapes are static.
+    """
+    name = str(mi.get("name", ""))
+    mtype = int(mi.get("type", 2))
+
+    rA0 = np.array(mi["rA"], dtype=float)
+    rB0 = np.array(mi["rB"], dtype=float)
+    if (rA0[2] == 0 or rB0[2] == 0) and mtype != 3:
+        raise ValueError("Members cannot start or end on the waterplane")
+    if rB0[2] < rA0[2]:
+        rA0, rB0 = rB0.copy(), rA0.copy()
+
+    shape = "circular" if str(mi["shape"])[0].lower() == "c" else (
+        "rectangular" if str(mi["shape"])[0].lower() == "r" else None
+    )
+    if shape is None:
+        raise ValueError("The only allowable shape strings are circular and rectangular")
+
+    pot_mod = bool(get_from_dict(mi, "potMod", dtype=bool, default=False))
+    mcf = bool(get_from_dict(mi, "MCF", dtype=bool, default=False))
+    gamma = float(get_from_dict(mi, "gamma", default=0.0))
+
+    rAB = rB0 - rA0
+    length = float(np.linalg.norm(rAB))
+
+    if heading != 0.0:
+        c, s = np.cos(np.deg2rad(heading)), np.sin(np.deg2rad(heading))
+        rot = np.array([[c, -s, 0.0], [s, c, 0.0], [0.0, 0.0, 1.0]])
+        rA0 = rot @ rA0
+        rB0 = rot @ rB0
+        if rAB[0] == 0.0 and rAB[1] == 0.0:  # vertical member: heading acts as twist
+            gamma += heading
+
+    st = np.array(mi["stations"], dtype=float)
+    n = len(st)
+    if n < 2:
+        raise ValueError("At least two stations entries must be provided")
+    if sorted(st.tolist()) != st.tolist():
+        raise ValueError(f"Member {name}: the station list is not in ascending order.")
+    stations_frac = (st - st[0]) / (st[-1] - st[0])
+    stations = stations_frac * length
+
+    if shape == "circular":
+        d = get_from_dict(mi, "d", shape=n)
+        gamma = 0.0  # twist is meaningless for circular sections
+    else:
+        d = get_from_dict(mi, "d", shape=[n, 2])
+    if mcf and shape != "circular":
+        mcf = False  # MacCamy-Fuchs only applies to circular members
+
+    t = get_from_dict(mi, "t", shape=n)
+    rho_shell = float(get_from_dict(mi, "rho_shell", shape=0, default=8500.0))
+
+    st_fill = get_from_dict(mi, "l_fill", shape=n - 1, default=0)
+    for i in range(n - 1):
+        if st_fill[i] < 0:
+            raise ValueError(f"Member {name}: ballast level in section {i + 1} is negative.")
+        if st_fill[i] > st[i + 1] - st[i]:
+            raise ValueError(
+                f"Member {name}: ballast level in section {i + 1} exceeds section length."
+            )
+    l_fill_frac = st_fill / (st[-1] - st[0])
+
+    rho_fill_in = get_from_dict(mi, "rho_fill", shape=-1, default=1025)
+    if np.isscalar(rho_fill_in):
+        rho_fill = np.zeros(n - 1) + rho_fill_in
+    else:
+        rho_fill = np.asarray(rho_fill_in, dtype=float)
+        if len(rho_fill) != n - 1:
+            raise ValueError(
+                f"Member {name}: number of ballast densities must be one less than stations."
+            )
+
+    # ----- end caps / bulkheads: resolve which interpolation branch applies -----
+    cap_st_in = get_from_dict(mi, "cap_stations", shape=-1, default=[])
+    if np.isscalar(cap_st_in):
+        cap_st_in = np.array([cap_st_in], dtype=float)
+    n_caps = len(cap_st_in)
+    if n_caps:
+        cap_t = get_from_dict(mi, "cap_t", shape=n_caps)
+        if shape == "circular":
+            cap_d_in = get_from_dict(mi, "cap_d_in", shape=n_caps)
+        else:
+            cap_d_in = np.asarray(get_from_dict(mi, "cap_d_in", shape=-1), dtype=float)
+            cap_d_in = np.broadcast_to(np.atleast_2d(cap_d_in), (n_caps, 2)).copy()
+        cap_stations_frac_np = (np.asarray(cap_st_in, dtype=float) - st[0]) / (st[-1] - st[0])
+        cap_stations = cap_stations_frac_np * length
+        cap_kinds = []
+        for i in range(n_caps):
+            L, h = cap_stations[i], cap_t[i]
+            if L == stations[0]:
+                cap_kinds.append("bottom")
+            elif L == stations[-1]:
+                cap_kinds.append("top")
+            elif (stations[0] < L < stations[0] + h) or (stations[-1] - h < L < stations[-1]):
+                raise ValueError("Cap placement within a cap-thickness of the member end is unsupported")
+            elif i < n_caps - 1 and L == cap_stations[i + 1]:
+                # member discontinuity: paired caps at the same station —
+                # this one closes the lower member going down
+                cap_kinds.append("disc_down")
+            elif i > 0 and L == cap_stations[i - 1]:
+                # ... and this one closes the upper member going up
+                cap_kinds.append("disc_up")
+            else:
+                cap_kinds.append("mid")
+    else:
+        cap_t = np.zeros(0)
+        cap_d_in = np.zeros(0) if shape == "circular" else np.zeros((0, 2))
+        cap_stations = np.zeros(0)
+        cap_stations_frac_np = np.zeros(0)
+        cap_kinds = []
+
+    # coefficient tables (per station)
+    Cd_q = get_from_dict(mi, "Cd_q", shape=n, default=0.0)
+    Cd_p1 = get_from_dict(mi, "Cd", shape=n, default=0.6, index=0)
+    Cd_p2 = get_from_dict(mi, "Cd", shape=n, default=0.6, index=1)
+    Cd_end = get_from_dict(mi, "CdEnd", shape=n, default=0.6)
+    Ca_q = get_from_dict(mi, "Ca_q", shape=n, default=0.0)
+    Ca_p1 = get_from_dict(mi, "Ca", shape=n, default=0.97, index=0)
+    Ca_p2 = get_from_dict(mi, "Ca", shape=n, default=0.97, index=1)
+    Ca_end = get_from_dict(mi, "CaEnd", shape=n, default=0.6)
+
+    # ----- freeze the strip-node layout (counts only; positions stay traced) -----
+    dls_max = float(np.asarray(mi.get("dlsMax", dls_max_default)).reshape(-1)[0])
+    seg_nodes = []
+    seg_flat = []
+    for i in range(1, n):
+        lstrip = stations[i] - stations[i - 1]
+        if lstrip > 0.0:
+            seg_nodes.append(int(np.ceil(lstrip / dls_max)))
+            seg_flat.append(False)
+        else:
+            seg_nodes.append(1)
+            seg_flat.append(True)
+
+    topo = MemberTopology(
+        shape=shape,
+        n_st=n,
+        seg_nodes=tuple(seg_nodes),
+        seg_flat=tuple(seg_flat),
+        cap_kinds=tuple(cap_kinds),
+        pot_mod=pot_mod,
+        mcf=mcf,
+        type=mtype,
+        name=name,
+    )
+    geom = MemberGeometry(
+        rA0=jnp.asarray(rA0),
+        rB0=jnp.asarray(rB0),
+        gamma=jnp.asarray(float(gamma)),
+        stations_frac=jnp.asarray(stations_frac),
+        d=jnp.asarray(d),
+        t=jnp.asarray(t),
+        l_fill_frac=jnp.asarray(l_fill_frac),
+        rho_fill=jnp.asarray(rho_fill),
+        rho_shell=jnp.asarray(rho_shell),
+        Cd_q=jnp.asarray(Cd_q),
+        Cd_p1=jnp.asarray(Cd_p1),
+        Cd_p2=jnp.asarray(Cd_p2),
+        Cd_end=jnp.asarray(Cd_end),
+        Ca_q=jnp.asarray(Ca_q),
+        Ca_p1=jnp.asarray(Ca_p1),
+        Ca_p2=jnp.asarray(Ca_p2),
+        Ca_end=jnp.asarray(Ca_end),
+        cap_stations_frac=jnp.asarray(cap_stations_frac_np),
+        cap_t=jnp.asarray(cap_t),
+        cap_d_in=jnp.asarray(cap_d_in),
+    )
+    return CompiledMember(topo=topo, geom=geom)
+
+
+# ---------------------------------------------------------------------------
+# pose / discretization
+# ---------------------------------------------------------------------------
+
+
+def axis_length(geom: MemberGeometry):
+    """Traced member length |rB0 - rA0| — the scale for all along-axis
+    fractional coordinates (keeps end-position perturbations differentiable)."""
+    return jnp.linalg.norm(geom.rB0 - geom.rA0)
+
+
+def _safe_norm2(x, y):
+    """sqrt(x²+y²) with a well-defined (zero) gradient at the origin —
+    vertical members are the common case and d(sqrt)/dx at 0 is inf."""
+    s = x * x + y * y
+    return jnp.where(s > 0, jnp.sqrt(jnp.where(s > 0, s, 1.0)), 0.0)
+
+
+def _discretize(topo: MemberTopology, geom: MemberGeometry):
+    """Strip discretization with the reference's node layout
+    (raft_member.py:169-216), node counts static from the topology.
+    Builds one vectorized block per segment and concatenates (a handful of
+    ops per segment rather than per node)."""
+    st = geom.stations_frac * axis_length(geom)
+    d = geom.d
+    rect = topo.shape == "rectangular"
+    zero = jnp.zeros((1,), dtype=st.dtype)
+
+    ls_parts = [zero]
+    dls_parts = [zero]
+    ds_parts = [(0.5 * d[0])[None]]
+    drs_parts = [(0.5 * d[0])[None]]
+
+    for i in range(1, topo.n_st):
+        lstrip = st[i] - st[i - 1]
+        if not topo.seg_flat[i - 1]:
+            ns = topo.seg_nodes[i - 1]
+            dlstrip = lstrip / ns
+            m = 0.5 * (d[i] - d[i - 1]) / lstrip
+            j = jnp.arange(ns, dtype=st.dtype) + 0.5
+            ls_parts.append(st[i - 1] + dlstrip * j)
+            dls_parts.append(jnp.broadcast_to(dlstrip, (ns,)))
+            if rect:
+                ds_parts.append(d[i - 1][None, :] + (dlstrip * j)[:, None] * 2 * m[None, :])
+                drs_parts.append(jnp.broadcast_to(dlstrip * m, (ns, 2)))
+            else:
+                ds_parts.append(d[i - 1] + dlstrip * 2 * m * j)
+                drs_parts.append(jnp.broadcast_to(dlstrip * m, (ns,)))
+        else:
+            ls_parts.append(st[i - 1][None])
+            dls_parts.append(zero)
+            ds_parts.append((0.5 * (d[i - 1] + d[i]))[None])
+            drs_parts.append((0.5 * (d[i] - d[i - 1]))[None])
+
+    ls_parts.append(st[-1][None])
+    dls_parts.append(zero)
+    ds_parts.append((0.5 * d[-1])[None])
+    drs_parts.append((-0.5 * d[-1])[None])
+
+    return (
+        jnp.concatenate(ls_parts),
+        jnp.concatenate(dls_parts),
+        jnp.concatenate(ds_parts),
+        jnp.concatenate(drs_parts),
+    )
+
+
+def member_pose(topo: MemberTopology, geom: MemberGeometry, r6=None) -> MemberPose:
+    """Member orientation and node positions under platform pose ``r6``.
+
+    Parity with Member.setPosition (raft_member.py:245-304): Z1Y2Z3
+    intrinsic Euler construction from the member axis + twist gamma, then
+    platform rotation/translation applied on top.
+    """
+    if r6 is None:
+        r6 = jnp.zeros(6)
+    r6 = jnp.asarray(r6)
+
+    rAB0 = geom.rB0 - geom.rA0
+    length = jnp.linalg.norm(rAB0)
+    q0 = rAB0 / length
+
+    beta = jnp.arctan2(q0[1], q0[0])
+    phi = jnp.arctan2(_safe_norm2(q0[0], q0[1]), q0[2])
+    s1, c1 = jnp.sin(beta), jnp.cos(beta)
+    s2, c2 = jnp.sin(phi), jnp.cos(phi)
+    g = jnp.deg2rad(geom.gamma)
+    s3, c3 = jnp.sin(g), jnp.cos(g)
+
+    R0 = jnp.array(
+        [
+            [c1 * c2 * c3 - s1 * s3, -c3 * s1 - c1 * c2 * s3, c1 * s2],
+            [c1 * s3 + c2 * c3 * s1, c1 * c3 - c2 * s1 * s3, s1 * s2],
+            [-c3 * s2, s2 * s3, c2],
+        ]
+    )
+    p1_0 = R0 @ jnp.array([1.0, 0.0, 0.0])
+
+    R_pl = transforms.rotation_matrix(r6[3:])
+    R = R_pl @ R0
+    q = R_pl @ q0
+    p1 = R_pl @ p1_0
+    p2 = jnp.cross(q, p1)
+
+    rA = transforms.transform_position(geom.rA0, r6)
+    rB = transforms.transform_position(geom.rB0, r6)
+
+    ls, dls, ds, drs = _discretize(topo, geom)
+    r = rA + (ls / length)[:, None] * (rB - rA)
+
+    return MemberPose(R=R, q=q, p1=p1, p2=p2, rA=rA, rB=rB, r=r, ls=ls, dls=dls, ds=ds, drs=drs, l=length)
+
+
+# ---------------------------------------------------------------------------
+# inertia
+# ---------------------------------------------------------------------------
+
+
+def _segment_mass_props(topo: MemberTopology, geom: MemberGeometry):
+    """Pose-independent per-segment masses, centroids, and local MoIs
+    (the per-submember section of Member.getInertia, raft_member.py:416-526).
+    Returns arrays over the n_st-1 segments."""
+    st = geom.stations_frac * axis_length(geom)
+    lseg = st[1:] - st[:-1]  # [n_seg]
+    rho_sh = geom.rho_shell
+    lf = geom.l_fill_frac * axis_length(geom)
+    rf = geom.rho_fill
+    nonzero = lseg > 0
+    lsafe = jnp.where(nonzero, lseg, 1.0)
+
+    if topo.shape == "circular":
+        dA, dB = geom.d[:-1], geom.d[1:]
+        dAi = dA - 2 * geom.t[:-1]
+        dBi = dB - 2 * geom.t[1:]
+        V_outer, hco = frustum.frustum_vcv_circ(dA, dB, lseg)
+        V_inner, hci = frustum.frustum_vcv_circ(dAi, dBi, lseg)
+        dBi_fill = (dBi - dAi) * (lf / lsafe) + dAi
+        v_fill, hc_fill = frustum.frustum_vcv_circ(dAi, dBi_fill, lf)
+        I_rad_o, I_ax_o = frustum.frustum_moi_circ(dA, dB, lseg, rho_sh)
+        I_rad_i, I_ax_i = frustum.frustum_moi_circ(dAi, dBi, lseg, rho_sh)
+        I_rad_f, I_ax_f = frustum.frustum_moi_circ(dAi, dBi_fill, lf, rf)
+        circ = True
+    else:
+        slA, slB = geom.d[:-1], geom.d[1:]
+        slAi = slA - 2 * geom.t[:-1, None]
+        slBi = slB - 2 * geom.t[1:, None]
+        V_outer, hco = frustum.frustum_vcv_rect(slA, slB, lseg)
+        V_inner, hci = frustum.frustum_vcv_rect(slAi, slBi, lseg)
+        slBi_fill = (slBi - slAi) * (lf / lsafe)[:, None] + slAi
+        v_fill, hc_fill = frustum.frustum_vcv_rect(slAi, slBi_fill, lf)
+        Ixx_o, Iyy_o, Izz_o = frustum.frustum_moi_rect(slA, slB, lseg, rho_sh)
+        Ixx_i, Iyy_i, Izz_i = frustum.frustum_moi_rect(slAi, slBi, lseg, rho_sh)
+        Ixx_f, Iyy_f, Izz_f = frustum.frustum_moi_rect(slAi, slBi_fill, lf, rf)
+        circ = False
+
+    v_shell = V_outer - V_inner
+    m_shell = v_shell * rho_sh
+    vsafe = jnp.where(v_shell > 0, v_shell, 1.0)
+    hc_shell = (hco * V_outer - hci * V_inner) / vsafe
+    m_fill = v_fill * rf
+    mass = m_shell + m_fill
+    msafe = jnp.where(mass > 0, mass, 1.0)
+    hc = (hc_fill * m_fill + hc_shell * m_shell) / msafe
+
+    if circ:
+        I_rad_end = (I_rad_o - I_rad_i) + I_rad_f
+        I_rad = I_rad_end - mass * hc**2
+        I_ax = (I_ax_o - I_ax_i) + I_ax_f
+        Ixx = Iyy = I_rad
+        Izz = I_ax
+    else:
+        Ixx_end = (Ixx_o - Ixx_i) + Ixx_f
+        Iyy_end = (Iyy_o - Iyy_i) + Iyy_f
+        Izz = (Izz_o - Izz_i) + Izz_f
+        Ixx = Ixx_end - mass * hc**2
+        Iyy = Iyy_end - mass * hc**2
+
+    # zero-length segments contribute nothing
+    z = nonzero
+    mass = jnp.where(z, mass, 0.0)
+    m_shell = jnp.where(z, m_shell, 0.0)
+    m_fill = jnp.where(z, m_fill, 0.0)
+    v_fill = jnp.where(z, v_fill, 0.0)
+    hc = jnp.where(z, hc, 0.0)
+    Ixx = jnp.where(z, Ixx, 0.0)
+    Iyy = jnp.where(z, Iyy, 0.0)
+    Izz = jnp.where(z, Izz, 0.0)
+    return mass, hc, m_shell, m_fill, v_fill, Ixx, Iyy, Izz
+
+
+def _cap_mass_props(topo: MemberTopology, geom: MemberGeometry):
+    """Pose-independent cap/bulkhead masses and local MoIs
+    (raft_member.py:553-671).  Branches are static via topo.cap_kinds."""
+    masses, hcs, Ixxs, Iyys, Izzs, Ls, hs = [], [], [], [], [], [], []
+    circ = topo.shape == "circular"
+    st = geom.stations_frac * axis_length(geom)
+    d_in_profile = geom.d - (2 * geom.t if circ else 2 * geom.t[:, None])
+
+    def interp_profile(x):
+        if circ:
+            return jnp.interp(x, st, d_in_profile)
+        return jnp.stack([jnp.interp(x, st, d_in_profile[:, 0]), jnp.interp(x, st, d_in_profile[:, 1])])
+
+    for i, kind in enumerate(topo.cap_kinds):
+        L = geom.cap_stations_frac[i] * axis_length(geom)
+        h = geom.cap_t[i]
+        hole = geom.cap_d_in[i]
+        if kind == "bottom":
+            dA = d_in_profile[0]
+            dB = interp_profile(L + h)
+            dAi = hole
+            dBi = dB * (dAi / dA)
+        elif kind == "top":
+            dA = interp_profile(L - h)
+            dB = d_in_profile[-1]
+            dBi = hole
+            dAi = dA * (dBi / dB)
+        elif kind == "disc_down":
+            # paired cap at a member discontinuity, closing downward; note
+            # the reference indexes the diameter profile by *cap* index
+            # here (raft_member.py:582-586) — reproduced as-is
+            dA = interp_profile(L - h)
+            dB = d_in_profile[i]
+            dBi = hole
+            dAi = dA * (dBi / dB)
+        elif kind == "disc_up":
+            dA = d_in_profile[i]
+            dB = interp_profile(L + h)
+            dAi = hole
+            dBi = dB * (dAi / dA)
+        else:  # mid bulkhead
+            dA = interp_profile(L - h / 2)
+            dB = interp_profile(L + h / 2)
+            dM = interp_profile(L)
+            dAi = dA * (hole / dM)
+            dBi = dB * (hole / dM)
+
+        if circ:
+            V_o, hco = frustum.frustum_vcv_circ(dA, dB, h)
+            V_i, hci = frustum.frustum_vcv_circ(dAi, dBi, h)
+            I_rad_o, I_ax_o = frustum.frustum_moi_circ(dA, dB, h, geom.rho_shell)
+            I_rad_i, I_ax_i = frustum.frustum_moi_circ(dAi, dBi, h, geom.rho_shell)
+            v_cap = V_o - V_i
+            m_cap = v_cap * geom.rho_shell
+            hc_cap = (hco * V_o - hci * V_i) / jnp.where(v_cap > 0, v_cap, 1.0)
+            I_rad = (I_rad_o - I_rad_i) - m_cap * hc_cap**2
+            Ixx = Iyy = I_rad
+            Izz = I_ax_o - I_ax_i
+        else:
+            V_o, hco = frustum.frustum_vcv_rect(dA, dB, h)
+            V_i, hci = frustum.frustum_vcv_rect(dAi, dBi, h)
+            Ixx_o, Iyy_o, Izz_o = frustum.frustum_moi_rect(dA, dB, h, geom.rho_shell)
+            Ixx_i, Iyy_i, Izz_i = frustum.frustum_moi_rect(dAi, dBi, h, geom.rho_shell)
+            v_cap = V_o - V_i
+            m_cap = v_cap * geom.rho_shell
+            hc_cap = (hco * V_o - hci * V_i) / jnp.where(v_cap > 0, v_cap, 1.0)
+            Ixx = (Ixx_o - Ixx_i) - m_cap * hc_cap**2
+            Iyy = (Iyy_o - Iyy_i) - m_cap * hc_cap**2
+            Izz = Izz_o - Izz_i
+
+        masses.append(m_cap)
+        hcs.append(hc_cap)
+        Ixxs.append(Ixx)
+        Iyys.append(Iyy)
+        Izzs.append(Izz)
+        Ls.append(L)
+        hs.append(h)
+
+    if not masses:
+        zero = jnp.zeros(0)
+        return zero, zero, zero, zero, zero, zero, zero
+    return (
+        jnp.stack(masses),
+        jnp.stack(hcs),
+        jnp.stack(Ixxs),
+        jnp.stack(Iyys),
+        jnp.stack(Izzs),
+        jnp.stack(Ls),
+        jnp.stack(hs),
+    )
+
+
+def member_inertia(topo: MemberTopology, geom: MemberGeometry, pose: MemberPose, rPRP=None):
+    """Member mass/inertia rollup about the PRP in global directions.
+
+    Returns (M_struc [6,6], mass, center [3], m_shell, m_fill [n_seg],
+    rho_fill [n_seg]) with the same semantics as Member.getInertia
+    (raft_member.py:307-707): per-segment local MoI rotated by the member
+    DCM and translated to the PRP, caps included in the shell mass.
+    """
+    if rPRP is None:
+        rPRP = jnp.zeros(3)
+    rPRP = jnp.asarray(rPRP)
+
+    mass_s, hc_s, mshell_s, mfill_s, vfill_s, Ixx_s, Iyy_s, Izz_s = _segment_mass_props(topo, geom)
+    st = geom.stations_frac * axis_length(geom)
+
+    # segment CG positions rel. PRP, global orientation
+    centers = pose.rA + pose.q[None, :] * (st[:-1] + hc_s)[:, None] - rPRP
+
+    def seg_matrix(mass, Ixx, Iyy, Izz, center):
+        Mmat = jnp.diag(jnp.array([mass, mass, mass, 0.0, 0.0, 0.0]))
+        I = jnp.diag(jnp.stack([Ixx, Iyy, Izz]))
+        I_rot = pose.R @ I @ pose.R.T
+        Mmat = Mmat.at[3:, 3:].set(I_rot)
+        return transforms.translate_matrix_6to6(Mmat, center)
+
+    M_segs = jax.vmap(seg_matrix)(mass_s, Ixx_s, Iyy_s, Izz_s, centers)
+    M_struc = jnp.sum(M_segs, axis=0)
+    mass_center = jnp.sum(mass_s[:, None] * centers, axis=0)
+    m_shell_tot = jnp.sum(mshell_s)
+
+    # caps
+    m_c, hc_c, Ixx_c, Iyy_c, Izz_c, L_c, h_c = _cap_mass_props(topo, geom)
+    if m_c.shape[0]:
+        pos_caps = pose.rA + pose.q[None, :] * L_c[:, None] - rPRP
+        offs = []
+        for i, kind in enumerate(topo.cap_kinds):
+            if kind == "bottom":
+                offs.append(hc_c[i])
+            elif kind == "top":
+                offs.append(-(h_c[i] - hc_c[i]))
+            else:
+                offs.append(-(h_c[i] / 2 - hc_c[i]))
+        centers_c = pos_caps + pose.q[None, :] * jnp.stack(offs)[:, None]
+        M_caps = jax.vmap(seg_matrix)(m_c, Ixx_c, Iyy_c, Izz_c, centers_c)
+        M_struc = M_struc + jnp.sum(M_caps, axis=0)
+        mass_center = mass_center + jnp.sum(m_c[:, None] * centers_c, axis=0)
+        m_shell_tot = m_shell_tot + jnp.sum(m_c)
+
+    mass = M_struc[0, 0]
+    center = mass_center / jnp.where(mass > 0, mass, 1.0)
+    return M_struc, mass, center, m_shell_tot, mfill_s, geom.rho_fill
+
+
+# ---------------------------------------------------------------------------
+# hydrostatics
+# ---------------------------------------------------------------------------
+
+
+def member_hydrostatics(topo: MemberTopology, geom: MemberGeometry, pose: MemberPose, rPRP=None,
+                        rho=RHO_WATER, g=GRAVITY):
+    """Buoyancy force vector, hydrostatic stiffness, and waterplane props.
+
+    Vectorized Member.getHydrostatics (raft_member.py:712-874): all
+    segments are evaluated for all three submergence cases and combined
+    with masks; waterplane quantities come from the (last) crossing
+    segment like the reference's overwrite semantics.
+    Returns (Fvec [6], Cmat [6,6], V_UW, r_center [3], AWP, IWP, xWP, yWP).
+    """
+    if rPRP is None:
+        rPRP = jnp.zeros(3)
+    rPRP = jnp.asarray(rPRP)
+    st = geom.stations_frac * axis_length(geom)
+    q = pose.q
+    circ = topo.shape == "circular"
+
+    rHS_ref = jnp.array([rPRP[0], rPRP[1], 0.0])
+    rA_seg = pose.rA + q[None, :] * st[:-1, None] - rHS_ref  # [n_seg,3]
+    rB_seg = pose.rA + q[None, :] * st[1:, None] - rHS_ref
+
+    zA, zB = rA_seg[:, 2], rB_seg[:, 2]
+    crossing = zA * zB <= 0
+    submerged = (~crossing) & (zA <= 0) & (zB <= 0)
+
+    beta = jnp.arctan2(q[1], q[0])
+    phi = jnp.arctan2(_safe_norm2(q[0], q[1]), q[2])
+    cosPhi, sinPhi, tanPhi = jnp.cos(phi), jnp.sin(phi), jnp.tan(phi)
+    cosBeta, sinBeta = jnp.cos(beta), jnp.sin(beta)
+
+    dz = jnp.where(jnp.abs(zB - zA) > 0, zB - zA, 1.0)
+    # interpolation factor to the waterplane, clamped so non-crossing
+    # segments can't extrapolate to negative side lengths (sqrt(A1*A2) in
+    # the rectangular frustum would turn that into NaN that survives the
+    # 0-weight mask)
+    fWP = jnp.clip((0.0 - zA) / dz, 0.0, 1.0)
+    xWP_seg = rA_seg[:, 0] + fWP * (rB_seg[:, 0] - rA_seg[:, 0])
+    yWP_seg = rA_seg[:, 1] + fWP * (rB_seg[:, 1] - rA_seg[:, 1])
+
+    # NOTE the reference interpolates the waterplane diameter with the
+    # station order swapped (d[i] at zA, d[i-1] at zB; raft_member.py:769)
+    # — reproduced verbatim since golden values embed it.
+    if circ:
+        dWP = geom.d[1:] + fWP * (geom.d[:-1] - geom.d[1:])
+        AWP_seg = (jnp.pi / 4) * dWP**2
+        IWP_seg = (jnp.pi / 64) * dWP**4
+        IxWP_seg = IWP_seg
+        IyWP_seg = IWP_seg
+    else:
+        slWP = geom.d[1:] + fWP[:, None] * (geom.d[:-1] - geom.d[1:])
+        AWP_seg = slWP[:, 0] * slWP[:, 1]
+        IxWP_l = (1.0 / 12.0) * slWP[:, 0] * slWP[:, 1] ** 3
+        IyWP_l = (1.0 / 12.0) * slWP[:, 0] ** 3 * slWP[:, 1]
+
+        def rot_wp(ix, iy):
+            I = jnp.diag(jnp.stack([ix, iy, jnp.zeros_like(ix)]))
+            I_rot = pose.R @ I @ pose.R.T
+            return I_rot[0, 0], I_rot[1, 1]
+
+        IxWP_seg, IyWP_seg = jax.vmap(rot_wp)(IxWP_l, IyWP_l)
+        # the reference only assigns the returned IWP in the circular branch
+        # (raft_member.py:771); rectangular members report IWP = 0
+        IWP_seg = jnp.zeros_like(AWP_seg)
+        dWP = None
+
+    cosSafe = jnp.where(jnp.abs(cosPhi) > 1e-12, cosPhi, 1e-12)
+    LWP = jnp.abs(zA / cosSafe)
+
+    # ---- partially submerged (crossing) case ----
+    if circ:
+        V_cross, hc_cross = frustum.frustum_vcv_circ(geom.d[:-1], dWP, LWP)
+    else:
+        V_cross, hc_cross = frustum.frustum_vcv_rect(geom.d[:-1], slWP, LWP)
+    r_center_cross = rA_seg + q[None, :] * hc_cross[:, None]
+
+    dPhi_dThx = -sinBeta
+    dPhi_dThy = cosBeta
+    Fz_cross = rho * g * V_cross
+    if circ:
+        M = -rho * g * jnp.pi * (dWP**2 / 32 * (2.0 + tanPhi**2) + 0.5 * (zA / cosSafe) ** 2) * sinPhi
+    else:
+        M = jnp.zeros_like(Fz_cross)
+    Mx_cross = M * dPhi_dThx
+    My_cross = M * dPhi_dThy
+
+    # ---- fully submerged case ----
+    lseg = st[1:] - st[:-1]
+    if circ:
+        V_sub, hc_sub = frustum.frustum_vcv_circ(geom.d[:-1], geom.d[1:], lseg)
+    else:
+        V_sub, hc_sub = frustum.frustum_vcv_rect(geom.d[:-1], geom.d[1:], lseg)
+    r_center_sub = rA_seg + q[None, :] * hc_sub[:, None]
+
+    # ---- combine with masks ----
+    cross_f = crossing.astype(st.dtype)
+    sub_f = submerged.astype(st.dtype)
+
+    Fvec = jnp.zeros(6, dtype=st.dtype)
+    Fvec = Fvec.at[2].add(jnp.sum(cross_f * Fz_cross))
+    Fvec = Fvec.at[3].add(jnp.sum(cross_f * (Mx_cross + Fz_cross * rA_seg[:, 1])))
+    Fvec = Fvec.at[4].add(jnp.sum(cross_f * (My_cross - Fz_cross * rA_seg[:, 0])))
+
+    F_sub = transforms.translate_force_3to6(
+        jnp.stack([jnp.zeros_like(V_sub), jnp.zeros_like(V_sub), rho * g * V_sub], axis=-1),
+        r_center_sub,
+    )  # [n_seg, 6]
+    Fvec = Fvec + jnp.sum(sub_f[:, None] * F_sub, axis=0)
+
+    Cmat = jnp.zeros((6, 6), dtype=st.dtype)
+    dFz_dz = -rho * g * AWP_seg / cosSafe
+    Cmat = Cmat.at[2, 2].add(jnp.sum(cross_f * (-dFz_dz)))
+    Cmat = Cmat.at[2, 3].add(jnp.sum(cross_f * rho * g * (-AWP_seg * yWP_seg)))
+    Cmat = Cmat.at[2, 4].add(jnp.sum(cross_f * rho * g * (AWP_seg * xWP_seg)))
+    Cmat = Cmat.at[3, 2].add(jnp.sum(cross_f * rho * g * (-AWP_seg * yWP_seg)))
+    Cmat = Cmat.at[3, 3].add(jnp.sum(cross_f * rho * g * (IxWP_seg + AWP_seg * yWP_seg**2)))
+    Cmat = Cmat.at[3, 4].add(jnp.sum(cross_f * rho * g * (AWP_seg * xWP_seg * yWP_seg)))
+    Cmat = Cmat.at[4, 2].add(jnp.sum(cross_f * rho * g * (AWP_seg * xWP_seg)))
+    Cmat = Cmat.at[4, 3].add(jnp.sum(cross_f * rho * g * (AWP_seg * xWP_seg * yWP_seg)))
+    Cmat = Cmat.at[4, 4].add(jnp.sum(cross_f * rho * g * (IyWP_seg + AWP_seg * xWP_seg**2)))
+    Cmat = Cmat.at[3, 3].add(jnp.sum(cross_f * rho * g * V_cross * r_center_cross[:, 2]))
+    Cmat = Cmat.at[4, 4].add(jnp.sum(cross_f * rho * g * V_cross * r_center_cross[:, 2]))
+    Cmat = Cmat.at[3, 3].add(jnp.sum(sub_f * rho * g * V_sub * r_center_sub[:, 2]))
+    Cmat = Cmat.at[4, 4].add(jnp.sum(sub_f * rho * g * V_sub * r_center_sub[:, 2]))
+
+    V_UW = jnp.sum(cross_f * V_cross + sub_f * V_sub)
+    r_centerV = jnp.sum(
+        (cross_f * V_cross)[:, None] * r_center_cross + (sub_f * V_sub)[:, None] * r_center_sub, axis=0
+    )
+    r_center = jnp.where(V_UW > 0, r_centerV / jnp.where(V_UW > 0, V_UW, 1.0), jnp.zeros(3))
+
+    # waterplane properties: reference keeps the LAST crossing segment's values
+    any_cross = jnp.any(crossing)
+    n_seg = st.shape[0] - 1
+    idx_last = (n_seg - 1) - jnp.argmax(crossing[::-1])
+    AWP = jnp.where(any_cross, AWP_seg[idx_last], 0.0)
+    IWP = jnp.where(any_cross, IWP_seg[idx_last], 0.0)
+    xWP = jnp.where(any_cross, xWP_seg[idx_last], 0.0)
+    yWP = jnp.where(any_cross, yWP_seg[idx_last], 0.0)
+
+    return Fvec, Cmat, V_UW, r_center, AWP, IWP, xWP, yWP
+
+
+# ---------------------------------------------------------------------------
+# strip-theory hydrodynamic coefficients (Morison added mass + FK excitation)
+# ---------------------------------------------------------------------------
+
+
+def node_coefficients(geom: MemberGeometry, pose: MemberPose):
+    """Per-node drag/added-mass coefficients, linearly interpolated in
+    along-axis position over the station tables (as np.interp does in
+    raft_member.py:916-919)."""
+    st = geom.stations_frac * axis_length(geom)
+
+    def it(tab):
+        return jnp.interp(pose.ls, st, tab)
+
+    return {
+        "Cd_q": it(geom.Cd_q),
+        "Cd_p1": it(geom.Cd_p1),
+        "Cd_p2": it(geom.Cd_p2),
+        "Cd_end": it(geom.Cd_end),
+        "Ca_q": it(geom.Ca_q),
+        "Ca_p1": it(geom.Ca_p1),
+        "Ca_p2": it(geom.Ca_p2),
+        "Ca_end": it(geom.Ca_end),
+    }
+
+
+def node_volumes_areas(topo: MemberTopology, pose: MemberPose):
+    """Per-node side volumes (with free-surface clipping), end volumes and
+    signed end areas (raft_member.py:922-950), plus the drag reference
+    areas used by the linearization (raft_fowt.py:1198-1238)."""
+    circ = topo.shape == "circular"
+    ds, drs, dls = pose.ds, pose.drs, pose.dls
+    z = pose.r[:, 2]
+
+    if circ:
+        v_side = 0.25 * jnp.pi * ds**2 * dls
+        v_end = jnp.pi / 12.0 * jnp.abs((ds + drs) ** 3 - (ds - drs) ** 3)
+        a_end = jnp.pi * ds * drs
+        a_drag_q = jnp.pi * ds * dls
+        a_drag_p1 = ds * dls
+        a_drag_p2 = ds * dls
+    else:
+        v_side = ds[:, 0] * ds[:, 1] * dls
+        dm_p = jnp.mean(ds + drs, axis=-1)
+        dm_m = jnp.mean(ds - drs, axis=-1)
+        v_end = jnp.pi / 12.0 * (dm_p**3 - dm_m**3)
+        a_end = (ds[:, 0] + drs[:, 0]) * (ds[:, 1] + drs[:, 1]) - (ds[:, 0] - drs[:, 0]) * (
+            ds[:, 1] - drs[:, 1]
+        )
+        # NOTE: the reference's rectangular axial drag area doubles ds[0]
+        # (2*(ds0+ds0); raft_fowt.py:1200) — kept for parity
+        a_drag_q = 2 * (ds[:, 0] + ds[:, 0]) * dls
+        a_drag_p1 = ds[:, 0] * dls
+        a_drag_p2 = ds[:, 1] * dls
+
+    # free-surface volume clipping for strips poking above z=0
+    dls_safe = jnp.where(dls > 0, dls, 1.0)
+    clip = jnp.where(z + 0.5 * dls > 0, (0.5 * dls - z) / dls_safe, 1.0)
+    v_side = v_side * clip
+
+    return {
+        "v_side": v_side,
+        "v_end": v_end,
+        "a_end": a_end,
+        "a_drag_q": a_drag_q,
+        "a_drag_p1": a_drag_p1,
+        "a_drag_p2": a_drag_p2,
+    }
+
+
+def member_hydro_constants(topo: MemberTopology, geom: MemberGeometry, pose: MemberPose,
+                           r_ref=None, rho=RHO_WATER, g=GRAVITY, k_array=None):
+    """Strip-theory added-mass and inertial-excitation coefficients.
+
+    Parity with Member.calcHydroConstants + calcImat + getCmSides
+    (raft_member.py:877-1088).  Returns a dict with per-node ``Amat``
+    [NN,3,3], ``Imat`` [NN,3,3] (plus ``Imat_mcf`` [NN,3,3,nw] complex if
+    ``k_array`` given and the member is MCF-flagged), signed end areas
+    ``a_i`` [NN], and the 6x6 rollups ``A_hydro``/``I_hydro`` about
+    ``r_ref``.  potMod members produce zeros (their loads come from BEM).
+    """
+    if r_ref is None:
+        r_ref = jnp.zeros(3)
+    r_ref = jnp.asarray(r_ref)
+
+    c = node_coefficients(geom, pose)
+    va = node_volumes_areas(topo, pose)
+
+    wet = pose.r[:, 2] < 0
+    if topo.pot_mod:  # potential-flow members carry no strip-theory loads
+        wet = jnp.zeros_like(wet)
+
+    qM = transforms.outer3(pose.q)
+    p1M = transforms.outer3(pose.p1)
+    p2M = transforms.outer3(pose.p2)
+
+    wet_f = wet.astype(pose.ls.dtype)
+    v_side = va["v_side"] * wet_f
+    v_end = va["v_end"] * wet_f
+    a_i = va["a_end"] * wet_f
+
+    Amat = (
+        rho * v_side[:, None, None] * (c["Ca_p1"][:, None, None] * p1M + c["Ca_p2"][:, None, None] * p2M)
+        + rho * v_end[:, None, None] * c["Ca_end"][:, None, None] * qM
+    )
+    Imat_end = rho * v_end[:, None, None] * c["Ca_end"][:, None, None] * qM
+    Imat = (
+        rho
+        * v_side[:, None, None]
+        * ((1.0 + c["Ca_p1"])[:, None, None] * p1M + (1.0 + c["Ca_p2"])[:, None, None] * p2M)
+        + Imat_end
+    )
+
+    offs = pose.r - r_ref
+    A_hydro = jnp.sum(transforms.translate_matrix_3to6(Amat, offs), axis=0)
+    I_hydro = jnp.sum(transforms.translate_matrix_3to6(Imat, offs), axis=0)
+
+    out = {"Amat": Amat, "Imat": Imat, "a_i": a_i, "A_hydro": A_hydro, "I_hydro": I_hydro}
+
+    if k_array is not None and topo.mcf:
+        out["Imat_mcf"] = _imat_mcf(topo, geom, pose, c, v_side, Imat_end, jnp.asarray(k_array), rho)
+    return out
+
+
+def _imat_mcf(topo, geom, pose, c, v_side, Imat_end, k_array, rho):
+    """Frequency-dependent complex FK matrix with the MacCamy-Fuchs Cm
+    (raft_member.py:1017-1048, 1053-1088), including the smooth short-wave
+    ramp between the Morison Cm and the MCF value."""
+    from ..ops import bessel
+
+    R = pose.ds / 2.0  # [NN] node radii (circular only — MCF gated on that)
+    kR = k_array[None, :] * R[:, None]  # [NN, nw]
+    kR_safe = jnp.where(kR > 0, kR, 1e-12)
+    Hp1 = 0.5 * (bessel.hankel1(0, kR_safe) - bessel.hankel1(2, kR_safe))
+    Cm_mcf = 4j / (jnp.pi * kR_safe**2 * Hp1)
+
+    Cm0_p1 = 1.0 + c["Ca_p1"]
+    Cm0_p2 = 1.0 + c["Ca_p2"]
+
+    R_safe = jnp.where(R > 0, R, 1.0)
+    Tr = jnp.pi / 5.0 / R_safe  # [NN] threshold wavenumber (λ/D = 5)
+    k_b = k_array[None, :]
+    ramp = jnp.where(
+        k_b <= 0.0,
+        0.0,
+        jnp.where(k_b < Tr[:, None], 0.5 * (1 - jnp.cos(jnp.pi * k_b / Tr[:, None])), 1.0),
+    )
+
+    Cm_p1 = Cm_mcf * ramp + Cm0_p1[:, None] * (1 - ramp)
+    Cm_p2 = Cm_mcf * ramp + Cm0_p2[:, None] * (1 - ramp)
+
+    p1M = transforms.outer3(pose.p1)
+    p2M = transforms.outer3(pose.p2)
+    # [NN,3,3,nw]
+    sides = rho * v_side[:, None, None, None] * (
+        Cm_p1[:, None, None, :] * p1M[None, :, :, None] + Cm_p2[:, None, None, :] * p2M[None, :, :, None]
+    )
+    return sides + Imat_end[:, :, :, None]

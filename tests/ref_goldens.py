@@ -1,0 +1,38 @@
+"""Helpers to pull golden values out of the reference test corpus.
+
+The reference's unit tests carry their expected values as inline numpy
+literals (e.g. /root/reference/tests/test_member.py:51-357).  Rather than
+duplicating hundreds of lines of numbers here, this module slices those
+assignment statements out of the (read-only) reference test files and
+evaluates just the literals.  Nothing else from the files is executed.
+"""
+
+from __future__ import annotations
+
+import ast
+import os
+
+import numpy as np
+
+REFERENCE_TESTS = "/root/reference/tests"
+
+
+def load_literals(test_file: str, names: list[str]) -> dict:
+    """Extract module-level ``name = <literal>`` assignments from a
+    reference test file and evaluate them with numpy in scope."""
+    path = os.path.join(REFERENCE_TESTS, test_file)
+    with open(path) as f:
+        tree = ast.parse(f.read())
+
+    wanted = set(names)
+    ns: dict = {"np": np}
+    for node in tree.body:
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            tgt = node.targets[0]
+            if isinstance(tgt, ast.Name) and tgt.id in wanted:
+                code = compile(ast.Expression(node.value), path, "eval")
+                ns[tgt.id] = eval(code, {"np": np})  # noqa: S307 - literals only
+    missing = wanted - ns.keys()
+    if missing:
+        raise KeyError(f"Could not find golden literals {missing} in {path}")
+    return {k: ns[k] for k in names}
