@@ -1,0 +1,13 @@
+"""TPU-native quasi-static mooring layer (MoorPy-equivalent).
+
+The reference delegates all mooring physics to the external MoorPy
+package (raft_fowt.py:168-186, raft_model.py:17-20).  Here the same
+capability is built as a differentiable JAX module: an elastic catenary
+line solver with implicit-function gradients (`catenary`), and a system
+assembler (`system`) that turns the RAFT mooring YAML into padded arrays
+and exposes body forces, coupled stiffness (via ``jax.jacfwd`` rather
+than finite differences), line tensions, and the tension Jacobian.
+"""
+
+from .catenary import solve_catenary  # noqa: F401
+from .system import CompiledMooring, compile_mooring  # noqa: F401

@@ -1,0 +1,211 @@
+"""Elastic catenary single-line solver (quasi-static, uniform line).
+
+Equivalent capability to the catenary kernel inside MoorPy (the
+reference's mooring dependency, used via ``ms.solveEquilibrium`` /
+``getCoupledStiffness`` at raft_fowt.py:286-288); implemented from the
+standard quasi-static mooring formulation (Jonkman 2007 / OpenFAST MAP
+lineage): closed-form suspended and seabed-contact profile equations,
+solved for the fairlead force components (HF, VF) by a damped Newton
+iteration inside ``lax.while_loop``.
+
+TPU-first design choices:
+
+- one *unified* residual covers the suspended and grounded regimes via
+  ``jnp.where`` masks, so a whole batch of lines (vmap over lines ×
+  designs × cases) shares one trace with no data-dependent branching;
+- gradients do not flow through the Newton loop: ``solve_catenary``
+  carries a ``jax.custom_jvp`` built from the implicit function theorem
+  (linearizing the profile residual at the solution), which is what
+  makes mooring stiffness = ``jacfwd`` of force exact and cheap.
+
+All quantities SI.  Geometry convention: the anchor (end A) is the
+lower end at the origin; ``xf`` >= 0 is the horizontal span to the
+fairlead (end B); ``zf`` >= 0 its height above the anchor; ``w`` > 0 is
+submerged weight per unit length; ``cb`` >= 0 the seabed friction
+coefficient (0 disables friction but keeps seabed contact).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+
+_TOL = 1e-10
+_MAX_ITER = 100
+
+
+def _asinh(x):
+    return jnp.arcsinh(x)
+
+
+def _profile_residual(hv, xf, zf, L, EA, w, cb):
+    """Residual [XF(HF,VF)-xf, ZF(HF,VF)-zf] for the unified profile.
+
+    Contact regime applies when VF < w*L (some line rests on the seabed,
+    anchor vertical load = 0); otherwise the line is fully suspended.
+    """
+    HF, VF = hv[0], hv[1]
+    HF = jnp.maximum(HF, _TOL)
+
+    contact_ok = cb >= 0.0  # cb < 0 flags a line hanging clear of the seabed
+    cb = jnp.maximum(cb, 0.0)
+
+    VFMinWL = VF - w * L
+    vh = VF / HF
+    vmh = VFMinWL / HF
+    s1 = jnp.sqrt(1.0 + vh**2)
+    s2 = jnp.sqrt(1.0 + vmh**2)
+    LOvrEA = L / EA
+
+    # --- fully suspended ---
+    xf_sus = HF / w * (_asinh(vh) - _asinh(vmh)) + HF * LOvrEA
+    zf_sus = HF / w * (s1 - s2) + (VF * L - 0.5 * w * L**2) / EA
+
+    # --- seabed contact (VF < wL): length LB on bottom, friction cb ---
+    LB = jnp.maximum(L - VF / w, 0.0)
+    # friction transition point: tension on the grounded portion reaches 0
+    # at distance HF/(cb*w) back from the touchdown point
+    cbw = jnp.maximum(cb * w, _TOL)
+    xF0 = jnp.maximum(LB - HF / cbw, 0.0)  # slack (zero-tension) grounded length
+    fric = jnp.where(
+        cb > 0.0,
+        cbw / (2.0 * EA) * (-LB**2 + xF0 * (LB - HF / cbw)),
+        0.0,
+    )
+    xf_con = LB + HF / w * _asinh(vh) + HF * LOvrEA + fric
+    zf_con = HF / w * (s1 - 1.0) + VF**2 / (2.0 * EA * w)
+
+    contact = (VF < w * L) & contact_ok
+    rx = jnp.where(contact, xf_con, xf_sus) - xf
+    rz = jnp.where(contact, zf_con, zf_sus) - zf
+    return jnp.stack([rx, rz])
+
+
+def _initial_guess(xf, zf, L, w):
+    """Jonkman's catenary starting point (lambda heuristic)."""
+    xf_safe = jnp.maximum(xf, _TOL)
+    taut = L**2 <= xf**2 + zf**2
+    lam_slack = jnp.sqrt(jnp.maximum(3.0 * ((L**2 - zf**2) / xf_safe**2 - 1.0), _TOL))
+    lam = jnp.where(taut, 0.2, lam_slack)
+    lam = jnp.where(xf <= _TOL, 1.0e6, lam)
+    HF0 = jnp.maximum(jnp.abs(0.5 * w * xf / lam), _TOL)
+    VF0 = 0.5 * w * (zf / jnp.tanh(lam) + L)
+    return jnp.stack([HF0, VF0])
+
+
+def _newton_solve(xf, zf, L, EA, w, cb):
+    """Damped Newton on (HF, VF); fixed trace, early-exit while_loop."""
+    hv0 = _initial_guess(xf, zf, L, w)
+    jac = jax.jacfwd(_profile_residual)
+
+    def cond(state):
+        hv, i, r = state
+        return (i < _MAX_ITER) & (jnp.max(jnp.abs(r)) > 1e-8 * jnp.maximum(L, 1.0))
+
+    def body(state):
+        hv, i, r = state
+        J = jac(hv, xf, zf, L, EA, w, cb)
+        # 2x2 solve with determinant guard
+        det = J[0, 0] * J[1, 1] - J[0, 1] * J[1, 0]
+        det = jnp.where(jnp.abs(det) > _TOL, det, jnp.sign(det) * _TOL + (det == 0) * _TOL)
+        dHF = (-r[0] * J[1, 1] + r[1] * J[0, 1]) / det
+        dVF = (r[0] * J[1, 0] - r[1] * J[0, 0]) / det
+        step = jnp.stack([dHF, dVF])
+        # damp: cap the step so HF stays positive and VF can't overshoot
+        # far below the grounded regime in one jump
+        new = hv + step
+        new = new.at[0].set(jnp.maximum(new[0], 0.1 * hv[0]))
+        new = new.at[1].set(jnp.maximum(new[1], jnp.minimum(hv[1] * 0.1, 0.0)))
+        return new, i + 1, _profile_residual(new, xf, zf, L, EA, w, cb)
+
+    r0 = _profile_residual(hv0, xf, zf, L, EA, w, cb)
+    hv, _, _ = jax.lax.while_loop(cond, body, (hv0, jnp.array(0), r0))
+    return hv
+
+
+@partial(jax.custom_jvp, nondiff_argnums=())
+def solve_catenary(xf, zf, L, EA, w, cb):
+    """Solve one catenary line; returns ``[HF, VF]`` fairlead force comps.
+
+    Differentiable in all six inputs via the implicit function theorem
+    (see the custom JVP below) — the basis for analytic mooring
+    stiffness matrices and tension Jacobians.
+    """
+    return _newton_solve(xf, zf, L, EA, w, cb)
+
+
+@solve_catenary.defjvp
+def _solve_catenary_jvp(primals, tangents):
+    xf, zf, L, EA, w, cb = primals
+    hv = solve_catenary(*primals)
+
+    # implicit function theorem: d(hv) = -J_hv^{-1} @ J_params @ d(params)
+    J_hv = jax.jacfwd(_profile_residual, argnums=0)(hv, *primals)
+    _, r_dot = jax.jvp(
+        lambda *p: _profile_residual(hv, *p),
+        primals,
+        tangents,
+    )
+    det = J_hv[0, 0] * J_hv[1, 1] - J_hv[0, 1] * J_hv[1, 0]
+    det = jnp.where(jnp.abs(det) > _TOL, det, _TOL)
+    dHF = (-r_dot[0] * J_hv[1, 1] + r_dot[1] * J_hv[0, 1]) / det
+    dVF = (r_dot[0] * J_hv[1, 0] - r_dot[1] * J_hv[0, 0]) / det
+    return hv, jnp.stack([dHF, dVF])
+
+
+def line_end_forces(xf, zf, L, EA, w, cb):
+    """2-D end forces for one line: ((HA, VA), (HF, VF)).
+
+    HF/VF act at the fairlead (line pulls the fairlead back toward the
+    anchor, -HF horizontally, and down, -VF).  HA/VA are the anchor-end
+    magnitudes: equal to fairlead values minus line weight when
+    suspended; friction-reduced horizontal and zero vertical when the
+    line touches down.
+    """
+    hv = solve_catenary(xf, zf, L, EA, w, cb)
+    HF, VF = hv[0], hv[1]
+    contact = (VF < w * L) & (cb >= 0.0)
+    LB = jnp.maximum(L - VF / w, 0.0)
+    HA = jnp.where(contact, jnp.maximum(HF - jnp.maximum(cb, 0.0) * w * LB, 0.0), HF)
+    VA = jnp.where(contact, 0.0, VF - w * L)
+    return HA, VA, HF, VF
+
+
+def line_profile(xf, zf, L, EA, w, cb, n=50):
+    """Sampled (x, z) coordinates along the line for plotting/export —
+    the analog of MoorPy's line.getCoordinate used by plot paths
+    (raft_model.py:1350-1365).  Host-facing; not performance critical."""
+    HA, VA, HF, VF = line_end_forces(xf, zf, L, EA, w, cb)
+    s = jnp.linspace(0.0, L, n)
+    contact = (VF < w * L) & (cb >= 0.0)
+    LB = jnp.maximum(L - VF / w, 0.0)
+
+    # suspended-profile coordinates measured from the anchor
+    Va_s = jnp.where(contact, 0.0, VF - w * L)  # vertical force at s=0
+    Vs = Va_s + w * s
+    HF_safe = jnp.maximum(HF, _TOL)
+    x_sus = HF / w * (_asinh(Vs / HF_safe) - _asinh(Va_s / HF_safe)) + HF * s / EA
+    z_sus = HF / w * (jnp.sqrt(1 + (Vs / HF_safe) ** 2) - jnp.sqrt(1 + (Va_s / HF_safe) ** 2)) + (
+        Va_s * s + 0.5 * w * s**2
+    ) / EA
+
+    # grounded portion: along the seabed, then a catenary from touchdown
+    on_bottom = s <= LB
+    sh = jnp.maximum(s - LB, 0.0)
+    Vh = w * sh
+    x_con = jnp.where(
+        on_bottom,
+        s,
+        LB + HF / w * _asinh(Vh / HF_safe) + HF * sh / EA,
+    )
+    z_con = jnp.where(
+        on_bottom,
+        0.0,
+        HF / w * (jnp.sqrt(1 + (Vh / HF_safe) ** 2) - 1.0) + Vh**2 / (2 * EA * w),
+    )
+
+    x = jnp.where(contact, x_con, x_sus)
+    z = jnp.where(contact, z_con, z_sus)
+    return x, z

@@ -1,0 +1,346 @@
+"""Mooring system assembly: RAFT mooring YAML -> differentiable forces.
+
+Covers the MoorPy System capabilities the reference consumes
+(raft_fowt.py:168-186, 276-288; raft_model.py:204-214, 346-359,
+598-658, 686-700, 801-811):
+
+- ``compile_mooring``     : parse the ``design['mooring']`` dict (schema at
+  /root/reference/docs/usage.rst:361-434) into fixed-shape arrays, with
+  the FOWT's reference-position transform applied (raft_fowt.py:185);
+- ``body_forces``         : net 6-DOF line force on the coupled body at pose
+  r6 (== Body.getForces(lines_only=True) after solveEquilibrium);
+- ``coupled_stiffness``   : -d F / d r6 by forward-mode AD (==
+  getCoupledStiffnessA; MoorPy's finite-difference getCoupledStiffness
+  is the same quantity);
+- ``tensions``            : line end tensions [TA1, TB1, TA2, ...] (==
+  System.getTensions ordering);
+- ``tension_jacobian``    : d tensions / d r6 (== the J_moor used for
+  mooring-tension FFTs at raft_model.py:359).
+
+Free (type 0) points — bridles, shared farm lines — are solved by an
+inner damped Newton over their coordinates inside ``lax.while_loop``;
+implicit differentiation comes for free because each catenary call
+already carries implicit-function JVPs, and the equilibrium itself is
+re-linearized through a custom JVP on the solve.
+
+Not yet modeled (reference parity TODOs): current drag on mooring lines
+(``ms.currentMod``, raft_model.py:572-578) and bathymetry files.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+from functools import partial
+from typing import Tuple
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from ..config import GRAVITY, RHO_WATER
+from ..ops import transforms
+from .catenary import line_end_forces
+
+_SEABED_TOL = 1.0e-3
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class MooringParams:
+    """Differentiable mooring description (jnp arrays)."""
+
+    p_loc: jnp.ndarray  # [n_pts,3] fixed: world; coupled: body-frame; free: initial guess
+    p_mass: jnp.ndarray  # [n_pts]
+    p_vol: jnp.ndarray  # [n_pts]
+    L: jnp.ndarray  # [n_lines] unstretched lengths
+    EA: jnp.ndarray  # [n_lines] axial stiffness
+    w: jnp.ndarray  # [n_lines] submerged weight per length
+    cb: jnp.ndarray  # [n_lines] seabed friction (<0 = no seabed contact)
+    depth: jnp.ndarray  # [] water depth
+
+
+@dataclasses.dataclass(frozen=True)
+class CompiledMooring:
+    """Static topology + differentiable parameters for one mooring system."""
+
+    n_points: int
+    n_lines: int
+    p_kind: Tuple[int, ...]  # 0 free, 1 fixed, -1 coupled to body
+    line_iA: Tuple[int, ...]
+    line_iB: Tuple[int, ...]
+    free_idx: Tuple[int, ...]  # indices of free points
+    params: MooringParams
+
+    @property
+    def has_free(self) -> bool:
+        return len(self.free_idx) > 0
+
+
+# ---------------------------------------------------------------------------
+# host-side compilation
+# ---------------------------------------------------------------------------
+
+
+def compile_mooring(mooring: dict, x_ref: float = 0.0, y_ref: float = 0.0,
+                    heading_adjust: float = 0.0, rho=RHO_WATER, g=GRAVITY) -> CompiledMooring:
+    """Parse ``design['mooring']`` into a CompiledMooring.
+
+    Mirrors MoorPy ``parseYAML`` + the FOWT's transform/initialize call
+    sequence (raft_fowt.py:168-186): vessel points become body-frame
+    attachments on one coupled body; the whole system is then rotated by
+    ``heading_adjust`` [deg] about z and shifted to (x_ref, y_ref).
+    """
+    depth = float(mooring.get("water_depth", 0.0))
+
+    ltypes = {lt["name"]: lt for lt in mooring.get("line_types", [])}
+
+    names, kinds, locs, masses, vols = [], [], [], [], []
+    for pt in mooring["points"]:
+        names.append(pt["name"])
+        t = str(pt["type"]).lower()
+        if t in ("fixed", "fix", "anchor"):
+            kinds.append(1)
+        elif t in ("vessel", "coupled", "body1"):
+            kinds.append(-1)
+        else:  # 'free' / 'connect'
+            kinds.append(0)
+        locs.append(np.array(pt["location"], dtype=float))
+        masses.append(float(pt.get("mass", 0.0)))
+        vols.append(float(pt.get("volume", 0.0)))
+    idx = {n: i for i, n in enumerate(names)}
+
+    iA, iB, Ls, EAs, ws, cbs = [], [], [], [], [], []
+    for ln in mooring["lines"]:
+        a, b = idx[ln["endA"]], idx[ln["endB"]]
+        lt = ltypes[ln["type"]]
+        d_vol = float(lt["diameter"])
+        mden = float(lt["mass_density"])
+        w_sub = (mden - 0.25 * np.pi * d_vol**2 * rho) * g
+        iA.append(a)
+        iB.append(b)
+        Ls.append(float(ln["length"]))
+        EAs.append(float(lt["stiffness"]))
+        ws.append(w_sub)
+        # seabed contact only when the line's lower end sits on the seabed
+        zA, zB = locs[a][2], locs[b][2]
+        lo_z = min(zA, zB)
+        cbs.append(0.0 if abs(lo_z + depth) < _SEABED_TOL else -1.0)
+
+    # reference-position transform (raft_fowt.py:185): rotate about z then shift
+    th = np.deg2rad(heading_adjust)
+    rot = np.array([[np.cos(th), -np.sin(th), 0.0], [np.sin(th), np.cos(th), 0.0], [0, 0, 1.0]])
+    locs = np.array(locs)
+    for i, k in enumerate(kinds):
+        if k != -1:  # coupled points stay body-frame; world points transform
+            locs[i] = rot @ locs[i]
+            locs[i, 0] += x_ref
+            locs[i, 1] += y_ref
+        else:
+            locs[i] = rot @ locs[i]  # body-frame attachment rotates with heading
+
+    params = MooringParams(
+        p_loc=jnp.asarray(locs),
+        p_mass=jnp.asarray(np.array(masses)),
+        p_vol=jnp.asarray(np.array(vols)),
+        L=jnp.asarray(np.array(Ls)),
+        EA=jnp.asarray(np.array(EAs)),
+        w=jnp.asarray(np.array(ws)),
+        cb=jnp.asarray(np.array(cbs)),
+        depth=jnp.asarray(depth),
+    )
+    return CompiledMooring(
+        n_points=len(names),
+        n_lines=len(Ls),
+        p_kind=tuple(kinds),
+        line_iA=tuple(iA),
+        line_iB=tuple(iB),
+        free_idx=tuple(i for i, k in enumerate(kinds) if k == 0),
+        params=params,
+    )
+
+
+# ---------------------------------------------------------------------------
+# traced physics
+# ---------------------------------------------------------------------------
+
+
+def point_positions(ms: CompiledMooring, params: MooringParams, r6, free_xyz=None):
+    """World positions of every point for body pose ``r6``.
+
+    Coupled points ride the body rigidly (MoorPy Body.setPosition uses
+    the same large-angle rotation matrix as the platform members).
+    """
+    r6 = jnp.asarray(r6)
+    R = transforms.rotation_matrix(r6[3:])
+    kinds = np.array(ms.p_kind)
+    coupled = jnp.asarray(kinds == -1)[:, None]
+    world = params.p_loc
+    body = r6[:3][None, :] + params.p_loc @ R.T
+    pos = jnp.where(coupled, body, world)
+    if free_xyz is not None and ms.has_free:
+        pos = pos.at[jnp.array(ms.free_idx)].set(free_xyz)
+    return pos
+
+
+def _line_forces_at_points(ms: CompiledMooring, params: MooringParams, pos):
+    """Per-line end forces in 3-D. Returns (F_endA, F_endB) arrays [n_lines,3]
+    and end tensions (TA, TB) [n_lines]."""
+    iA = jnp.array(ms.line_iA)
+    iB = jnp.array(ms.line_iB)
+    rA = pos[iA]
+    rB = pos[iB]
+
+    d = rB - rA
+    # work in the lo->hi frame expected by the catenary solver
+    swap = d[:, 2] < 0.0
+    lo = jnp.where(swap[:, None], rB, rA)
+    hi = jnp.where(swap[:, None], rA, rB)
+    dh = hi[:, :2] - lo[:, :2]
+    xf = jnp.sqrt(jnp.sum(dh**2, axis=1) + 1e-16)
+    zf = hi[:, 2] - lo[:, 2]
+    u = dh / xf[:, None]  # horizontal unit vector lo -> hi
+
+    HA, VA, HF, VF = jax.vmap(line_end_forces)(xf, zf, params.L, params.EA, params.w, params.cb)
+
+    F_lo = jnp.stack([HA * u[:, 0], HA * u[:, 1], VA], axis=1)
+    F_hi = jnp.stack([-HF * u[:, 0], -HF * u[:, 1], -VF], axis=1)
+
+    F_A = jnp.where(swap[:, None], F_hi, F_lo)
+    F_B = jnp.where(swap[:, None], F_lo, F_hi)
+    TA_ = jnp.sqrt(HA**2 + VA**2)
+    TB_ = jnp.sqrt(HF**2 + VF**2)
+    TA = jnp.where(swap, TB_, TA_)
+    TB = jnp.where(swap, TA_, TB_)
+    return F_A, F_B, TA, TB
+
+
+def _point_net_forces(ms: CompiledMooring, params: MooringParams, pos, rho=RHO_WATER, g=GRAVITY):
+    """Net force on every point: line pulls + weight/buoyancy. [n_pts,3]"""
+    F_A, F_B, _, _ = _line_forces_at_points(ms, params, pos)
+    net = jnp.zeros_like(pos)
+    net = net.at[jnp.array(ms.line_iA)].add(F_A)
+    net = net.at[jnp.array(ms.line_iB)].add(F_B)
+    Fz = -params.p_mass * g + params.p_vol * rho * g
+    net = net.at[:, 2].add(Fz)
+    return net
+
+
+def _solve_free_points_newton(ms: CompiledMooring, params: MooringParams, r6):
+    free_idx = jnp.array(ms.free_idx)
+    x0 = params.p_loc[free_idx].reshape(-1)
+
+    def resid(x):
+        pos = point_positions(ms, params, r6, free_xyz=x.reshape(-1, 3))
+        return _point_net_forces(ms, params, pos)[free_idx].reshape(-1)
+
+    def cond(state):
+        x, i, r = state
+        # converge to 1e-4 N absolute or 1e-9 of the initial imbalance,
+        # whichever is looser (taut-bridle systems carry 1e7 N tensions
+        # where 1e-4 N is below float64 cancellation noise)
+        return (i < 200) & (jnp.max(jnp.abs(r)) > tol)
+
+    scales = jnp.array([1.0, 0.5, 0.25, 0.1, 0.03, 0.01])
+
+    def body(state):
+        x, i, r = state
+        J = jax.jacfwd(resid)(x)
+        dx = jnp.linalg.solve(J, -r)
+        # cap the step length, then backtrack: taut lines make the force
+        # field so nonlinear that full Newton steps limit-cycle
+        nrm = jnp.linalg.norm(dx)
+        dx = jnp.where(nrm > 10.0, dx * (10.0 / nrm), dx)
+        cand = x[None, :] + scales[:, None] * dx[None, :]
+        rs = jax.vmap(resid)(cand)
+        best = jnp.argmin(jnp.linalg.norm(rs, axis=1))
+        return cand[best], i + 1, rs[best]
+
+    r0 = resid(x0)
+    tol = jnp.maximum(1e-4, 1e-9 * jnp.max(jnp.abs(r0)))
+    x, _, _ = jax.lax.while_loop(cond, body, (x0, jnp.array(0), r0))
+    return x
+
+
+@partial(jax.custom_jvp, nondiff_argnums=(0,))
+def _solve_free_points(ms: CompiledMooring, params: MooringParams, r6):
+    """Equilibrium coordinates of free points (flattened). Implicitly
+    differentiated so coupled stiffness sees through the inner solve."""
+    return _solve_free_points_newton(ms, params, r6)
+
+
+@_solve_free_points.defjvp
+def _solve_free_points_jvp(ms, primals, tangents):
+    params, r6 = primals
+    x = _solve_free_points(ms, params, r6)
+    free_idx = jnp.array(ms.free_idx)
+
+    def resid(xx, params_, r6_):
+        pos = point_positions(ms, params_, r6_, free_xyz=xx.reshape(-1, 3))
+        return _point_net_forces(ms, params_, pos)[free_idx].reshape(-1)
+
+    Jx = jax.jacfwd(resid, argnums=0)(x, params, r6)
+    _, r_dot = jax.jvp(lambda p_, r_: resid(x, p_, r_), primals, tangents)
+    x_dot = jnp.linalg.solve(Jx, -r_dot)
+    return x, x_dot
+
+
+def _equilibrium_positions(ms: CompiledMooring, params: MooringParams, r6):
+    if ms.has_free:
+        x = _solve_free_points(ms, params, r6)
+        return point_positions(ms, params, r6, free_xyz=x.reshape(-1, 3))
+    return point_positions(ms, params, r6)
+
+
+def body_forces(ms: CompiledMooring, params: MooringParams, r6):
+    """Net 6-DOF mooring force/moment on the coupled body at pose r6,
+    moments about the body origin (== Body.getForces(lines_only=True))."""
+    r6 = jnp.asarray(r6)
+    pos = _equilibrium_positions(ms, params, r6)
+    F_A, F_B, _, _ = _line_forces_at_points(ms, params, pos)
+
+    kinds = np.array(ms.p_kind)
+    iA = np.array(ms.line_iA)
+    iB = np.array(ms.line_iB)
+    onbodyA = jnp.asarray((kinds[iA] == -1).astype(float))
+    onbodyB = jnp.asarray((kinds[iB] == -1).astype(float))
+
+    offsA = pos[jnp.array(ms.line_iA)] - r6[:3]
+    offsB = pos[jnp.array(ms.line_iB)] - r6[:3]
+    F6_A = transforms.translate_force_3to6(F_A, offsA) * onbodyA[:, None]
+    F6_B = transforms.translate_force_3to6(F_B, offsB) * onbodyB[:, None]
+    return jnp.sum(F6_A, axis=0) + jnp.sum(F6_B, axis=0)
+
+
+def coupled_stiffness(ms: CompiledMooring, params: MooringParams, r6):
+    """6x6 mooring stiffness about the body pose: -dF/dr6 (lines only).
+    AD equivalent of getCoupledStiffnessA (raft_fowt.py:287)."""
+    return -jax.jacfwd(lambda r: body_forces(ms, params, r))(jnp.asarray(r6))
+
+
+def tensions(ms: CompiledMooring, params: MooringParams, r6):
+    """Line end tensions [TA_1, TB_1, TA_2, TB_2, ...] at equilibrium
+    (== System.getTensions ordering, consumed at raft_fowt.py:1882)."""
+    pos = _equilibrium_positions(ms, params, jnp.asarray(r6))
+    _, _, TA, TB = _line_forces_at_points(ms, params, pos)
+    return jnp.stack([TA, TB], axis=1).reshape(-1)
+
+
+def tension_jacobian(ms: CompiledMooring, params: MooringParams, r6):
+    """d(tensions)/d(r6) — the J_moor used for tension FFTs
+    (raft_model.py:353-359)."""
+    return jax.jacfwd(lambda r: tensions(ms, params, r))(jnp.asarray(r6))
+
+
+def fairlead_forces(ms: CompiledMooring, params: MooringParams, r6):
+    """Force magnitude at each body-attached (vessel) point — the
+    'fairlead tensions' mean output (raft_model.py:822)."""
+    pos = _equilibrium_positions(ms, params, jnp.asarray(r6))
+    F_A, F_B, _, _ = _line_forces_at_points(ms, params, pos)
+    kinds = np.array(ms.p_kind)
+    mags = []
+    for il in range(ms.n_lines):
+        if kinds[ms.line_iA[il]] == -1:
+            mags.append(jnp.linalg.norm(F_A[il]))
+        if kinds[ms.line_iB[il]] == -1:
+            mags.append(jnp.linalg.norm(F_B[il]))
+    return jnp.stack(mags) if mags else jnp.zeros(0)

@@ -22,6 +22,15 @@ def _poly(y, coeffs):
     return acc
 
 
+# The J/Y pair of each order shares one modulus/phase polynomial pair by
+# construction — kept as single constants so a precision fix can't
+# desynchronize them (H = J + iY phase would silently corrupt).
+_P1_ORD0 = [1.0, -0.1098628627e-2, 0.2734510407e-4, -0.2073370639e-5, 0.2093887211e-6]
+_P2_ORD0 = [-0.1562499995e-1, 0.1430488765e-3, -0.6911147651e-5, 0.7621095161e-6, -0.934935152e-7]
+_P1_ORD1 = [1.0, 0.183105e-2, -0.3516396496e-4, 0.2457520174e-5, -0.240337019e-6]
+_P2_ORD1 = [0.04687499995, -0.2002690873e-3, 0.8449199096e-5, -0.88228987e-6, 0.105787412e-6]
+
+
 def j0(x):
     x = jnp.asarray(x)
     ax = jnp.abs(x)
@@ -35,8 +44,8 @@ def j0(x):
     z = 8.0 / axs
     y2 = z * z
     xx = axs - 0.785398164
-    p1 = _poly(y2, [1.0, -0.1098628627e-2, 0.2734510407e-4, -0.2073370639e-5, 0.2093887211e-6])
-    p2 = _poly(y2, [-0.1562499995e-1, 0.1430488765e-3, -0.6911147651e-5, 0.7621095161e-6, -0.934935152e-7])
+    p1 = _poly(y2, _P1_ORD0)
+    p2 = _poly(y2, _P2_ORD0)
     large = jnp.sqrt(0.636619772 / axs) * (jnp.cos(xx) * p1 - z * jnp.sin(xx) * p2)
     return jnp.where(ax < 8.0, small, large)
 
@@ -54,8 +63,8 @@ def j1(x):
     z = 8.0 / axs
     y2 = z * z
     xx = axs - 2.356194491
-    p1 = _poly(y2, [1.0, 0.183105e-2, -0.3516396496e-4, 0.2457520174e-5, -0.240337019e-6])
-    p2 = _poly(y2, [0.04687499995, -0.2002690873e-3, 0.8449199096e-5, -0.88228987e-6, 0.105787412e-6])
+    p1 = _poly(y2, _P1_ORD1)
+    p2 = _poly(y2, _P2_ORD1)
     large = jnp.sign(x) * jnp.sqrt(0.636619772 / axs) * (jnp.cos(xx) * p1 - z * jnp.sin(xx) * p2)
     return jnp.where(ax < 8.0, small, large)
 
@@ -72,8 +81,8 @@ def y0(x):
     z = 8.0 / xl
     y2 = z * z
     xx = xl - 0.785398164
-    p1 = _poly(y2, [1.0, -0.1098628627e-2, 0.2734510407e-4, -0.2073370639e-5, 0.2093887211e-6])
-    p2 = _poly(y2, [-0.1562499995e-1, 0.1430488765e-3, -0.6911147651e-5, 0.7621095161e-6, -0.934935152e-7])
+    p1 = _poly(y2, _P1_ORD0)
+    p2 = _poly(y2, _P2_ORD0)
     large = jnp.sqrt(0.636619772 / xl) * (jnp.sin(xx) * p1 + z * jnp.cos(xx) * p2)
     return jnp.where(xs < 8.0, small, large)
 
@@ -92,8 +101,8 @@ def y1(x):
     z = 8.0 / xl
     y2 = z * z
     xx = xl - 2.356194491
-    p1 = _poly(y2, [1.0, 0.183105e-2, -0.3516396496e-4, 0.2457520174e-5, -0.240337019e-6])
-    p2 = _poly(y2, [0.04687499995, -0.2002690873e-3, 0.8449199096e-5, -0.88228987e-6, 0.105787412e-6])
+    p1 = _poly(y2, _P1_ORD1)
+    p2 = _poly(y2, _P2_ORD1)
     large = jnp.sqrt(0.636619772 / xl) * (jnp.sin(xx) * p1 + z * jnp.cos(xx) * p2)
     return jnp.where(xs < 8.0, small, large)
 
@@ -131,4 +140,5 @@ def hankel1_seq(n_max: int, x):
     for n in range(1, n_max):
         js.append(2.0 * n * js[n] / xs - js[n - 1])
         ys.append(2.0 * n * ys[n] / xs - ys[n - 1])
+    js, ys = js[: n_max + 1], ys[: n_max + 1]  # n_max=0 seeds two orders
     return jnp.stack([jr + 1j * yi for jr, yi in zip(js, ys)], axis=0)
