@@ -1,0 +1,1 @@
+from .fowt import FOWT  # noqa: F401

@@ -1,0 +1,41 @@
+"""Bridge between FOWT.calcTurbineConstants and the rotor aero module.
+
+Separated so the FOWT core has no import-time dependency on the BEM
+solver stack.  ``apply_rotor_aero`` fills the FOWT's aero-servo arrays
+(f_aero0, f_aero, A_aero, B_aero, B_gyro) for one rotor, mirroring the
+hub->platform transform block at raft_fowt.py:808-842.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import transforms
+
+
+def apply_rotor_aero(fowt, rot, ir, case, current, speed):
+    """Compute rotor aero for one case and fold into the FOWT arrays.
+
+    ``speed`` is the already-validated hub inflow speed resolved by
+    calcTurbineConstants (wind or current depending on submergence).
+    """
+    f_aero0, f_aero, a_aero, b_aero = rot.calcAero(case, current=current)
+
+    r_hub = np.asarray(rot.r_hub_rel)
+    for iw in range(fowt.nw):
+        fowt.A_aero[:, :, iw, ir] = np.asarray(
+            transforms.translate_matrix_6to6(a_aero[:, :, iw], r_hub)
+        )
+        fowt.B_aero[:, :, iw, ir] = np.asarray(
+            transforms.translate_matrix_6to6(b_aero[:, :, iw], r_hub)
+        )
+    fowt.f_aero0[:, ir] = np.asarray(transforms.transform_force(f_aero0, offset=r_hub))
+    for iw in range(fowt.nw):
+        fowt.f_aero[:, iw, ir] = np.asarray(transforms.transform_force(f_aero[:, iw], offset=r_hub))
+
+    # gyroscopic damping (raft_fowt.py:829-840)
+    if rot.Uhub.size:
+        Omega_rpm = np.interp(speed, rot.Uhub, rot.Omega_rpm)
+        Omega_rotor = np.asarray(rot.q) * Omega_rpm * 2 * np.pi / 60
+        IO_rotor = rot.I_drivetrain * Omega_rotor
+        fowt.B_gyro[3:, 3:, ir] = np.asarray(transforms.alternator(IO_rotor))

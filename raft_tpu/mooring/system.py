@@ -90,7 +90,10 @@ def compile_mooring(mooring: dict, x_ref: float = 0.0, y_ref: float = 0.0,
     attachments on one coupled body; the whole system is then rotated by
     ``heading_adjust`` [deg] about z and shifted to (x_ref, y_ref).
     """
-    depth = float(mooring.get("water_depth", 0.0))
+    # required, like the reference's design['mooring']['water_depth'] access
+    # (raft_model.py:2042) — a silent 0.0 default would disable seabed
+    # contact on every line
+    depth = float(mooring["water_depth"])
 
     ltypes = {lt["name"]: lt for lt in mooring.get("line_types", [])}
 

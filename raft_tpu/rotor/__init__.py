@@ -1,0 +1,1 @@
+from .rotor import Rotor  # noqa: F401

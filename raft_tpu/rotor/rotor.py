@@ -1,0 +1,157 @@
+"""Rotor-nacelle assembly: geometry, pose, and (host-side) parsing.
+
+Covers the geometry/statics portion of the reference Rotor class
+(/root/reference/raft/raft_rotor.py:37-173, 376-460): RNA reference
+point, overhang/CG offsets, shaft tilt/toe, yaw modes, and the pose
+update used by FOWT.calcStatics.  The aero-servo side (the
+CCBlade-equivalent JAX BEM solver, calcAero, control transfer
+functions) lives in :mod:`raft_tpu.rotor.bem` / :mod:`raft_tpu.rotor.aero`.
+
+The geometry math is plain NumPy on the host: rotor pose changes only
+at the (slow) statics level, while everything frequency-dependent flows
+through the traced aero/hydro kernels that consume these vectors as
+inputs.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from ..ops import transforms
+from ..schema import get_from_dict
+
+
+def _rotation_matrix_np(r, p, y):
+    """NumPy twin of ops.transforms.rotation_matrix for host-side pose math."""
+    return np.asarray(transforms.rotation_matrix(np.array([r, p, y], dtype=float)))
+
+
+class Rotor:
+    """One rotor-nacelle assembly of a FOWT."""
+
+    def __init__(self, turbine: dict, w, ir: int):
+        self.w = np.asarray(w, dtype=float)
+        self.nw = len(self.w)
+        self.ir = ir
+        self.turbine = turbine
+        nrotors = int(turbine.get("nrotors", 1))
+
+        # RNA reference point on the platform (raft_rotor.py:47-53)
+        if "rRNA" in turbine:
+            self.r_rel = np.array(get_from_dict(turbine, "rRNA", shape=[nrotors, 3])[ir], dtype=float)
+        else:
+            if nrotors > 1:
+                raise Exception(
+                    "For designs with more than one rotor, the RNA reference point must be specified for each of them."
+                )
+            self.r_rel = np.array([0.0, 0.0, 100.0])
+
+        self.overhang = float(get_from_dict(turbine, "overhang", shape=nrotors)[ir])
+        self.xCG_RNA = float(get_from_dict(turbine, "xCG_RNA", shape=nrotors)[ir])
+        self.mRNA = float(get_from_dict(turbine, "mRNA", shape=nrotors)[ir])
+        self.IxRNA = float(get_from_dict(turbine, "IxRNA", shape=nrotors)[ir])
+        self.IrRNA = float(get_from_dict(turbine, "IrRNA", shape=nrotors)[ir])
+        self.speed_gain = float(get_from_dict(turbine, "speed_gain", shape=nrotors, default=1.0)[ir])
+        self.nBlades = int(get_from_dict(turbine, "nBlades", shape=nrotors, dtype=int)[ir])
+
+        self.platform_heading = 0.0
+        self.yaw = 0.0
+        self.inflow_heading = 0.0
+        self.turbine_heading = 0.0
+        self.yaw_mode = int(get_from_dict(turbine, "yaw_mode", shape=nrotors, dtype=int, default=0)[ir])
+        self.yaw_command = 0.0
+
+        default_azimuths = list(np.arange(self.nBlades) * 360.0 / self.nBlades)
+        self.azimuths = get_from_dict(turbine, "headings", shape=-1, default=default_azimuths)
+
+        self.Rhub = float(get_from_dict(turbine, "Rhub", shape=nrotors)[ir])
+        self.precone = float(get_from_dict(turbine, "precone", shape=nrotors)[ir])
+        self.shaft_tilt = float(get_from_dict(turbine, "shaft_tilt", shape=nrotors)[ir]) * np.pi / 180
+        self.shaft_toe = float(get_from_dict(turbine, "shaft_toe", shape=nrotors, default=0)[ir]) * np.pi / 180
+        self.aeroServoMod = int(get_from_dict(turbine, "aeroServoMod", shape=nrotors, default=1)[ir])
+
+        # rotor axis unit vector (downflow) incl. tilt/toe (raft_rotor.py:99)
+        self.q_rel = _rotation_matrix_np(0.0, self.shaft_tilt, self.shaft_toe) @ np.array([1.0, 0.0, 0.0])
+        self.r3 = np.zeros(3)
+        self.q = np.array(self.q_rel)
+        self.R_ptfm = np.eye(3)
+
+        if "hHub" in turbine:
+            hHub = float(get_from_dict(turbine, "hHub", shape=nrotors)[ir])
+            self.r_rel[2] = hHub - self.q[2] * self.overhang
+        self.hHub = self.r_rel[2] + self.q[2] * self.overhang
+        self.Zhub = self.hHub
+
+        self.setPosition()
+
+        # operating schedule (raft_rotor.py:150-159), incl. parked extension
+        if "blade" in turbine:
+            blades = turbine["blade"]
+            if isinstance(blades, dict):
+                blades = [blades] * nrotors
+                turbine["blade"] = blades
+            self.R_rot = float(get_from_dict(blades[ir], "Rtip", shape=-1))
+        else:
+            self.R_rot = 0.0
+
+        if "wt_ops" in turbine:
+            ops = turbine["wt_ops"]
+            if isinstance(ops, dict):
+                ops = [ops] * nrotors
+                turbine["wt_ops"] = ops
+            self.Uhub = np.asarray(get_from_dict(ops[ir], "v", shape=-1), dtype=float)
+            self.Omega_rpm = np.asarray(get_from_dict(ops[ir], "omega_op", shape=-1), dtype=float)
+            self.pitch_deg = np.asarray(get_from_dict(ops[ir], "pitch_op", shape=-1), dtype=float)
+            self.Uhub = np.r_[self.Uhub, self.Uhub.max() * 1.4, 100]
+            self.Omega_rpm = np.r_[self.Omega_rpm, 0, 0]
+            self.pitch_deg = np.r_[self.pitch_deg, 90, 90]
+        else:
+            self.Uhub = np.zeros(0)
+            self.Omega_rpm = np.zeros(0)
+            self.pitch_deg = np.zeros(0)
+
+        self.I_drivetrain = float(get_from_dict(turbine, "I_drivetrain", shape=nrotors, default=0.0)[ir])
+
+    # ------------------------------------------------------------------
+    # pose
+    # ------------------------------------------------------------------
+
+    def setPosition(self, r6=None):
+        """Update rotor pose from the FOWT pose (raft_rotor.py:376-409)."""
+        if r6 is None:
+            r6 = np.zeros(6)
+        r6 = np.asarray(r6, dtype=float)
+        self.R_ptfm = _rotation_matrix_np(*r6[3:])
+        self.platform_heading = r6[5]
+        self.setYaw()
+        self.r_RRP_rel = self.R_ptfm @ self.r_rel
+        self.r_CG_rel = self.r_RRP_rel + self.q * self.xCG_RNA
+        self.r_hub_rel = self.r_RRP_rel + self.q * self.overhang
+        self.r3 = r6[:3] + self.r_hub_rel
+
+    def setYaw(self, yaw=None):
+        """Nacelle yaw update per yaw_mode (raft_rotor.py:412-460)."""
+        if yaw is not None:
+            self.yaw_command = np.radians(yaw)
+
+        if self.yaw_mode == 0:  # yaw command as inflow misalignment
+            self.yaw = self.inflow_heading - self.platform_heading + self.yaw_command
+        elif self.yaw_mode == 1:  # follow case turbine_heading
+            self.yaw = self.turbine_heading - self.platform_heading
+        elif self.yaw_mode == 2:  # yaw command relative to platform
+            self.yaw = self.yaw_command
+        elif self.yaw_mode == 3:  # yaw command as absolute heading
+            self.yaw = self.yaw_command - self.platform_heading
+        else:
+            raise Exception("Unsupported yaw_mode value. Must be 0, 1, 2, or 3.")
+
+        self.turbine_heading = self.platform_heading + self.yaw
+
+        # NOTE: the reference composes these as R_q = R_q_rel @ R_ptfm
+        # (raft_rotor.py:454) even though R_ptfm @ R_q_rel would be the
+        # conventional order; golden RNA inertia values embed this choice.
+        R_q_rel = _rotation_matrix_np(0.0, self.shaft_tilt, self.shaft_toe + self.yaw)
+        self.R_q = R_q_rel @ self.R_ptfm
+        self.q_rel = R_q_rel @ np.array([1.0, 0.0, 0.0])
+        self.q = self.R_ptfm @ self.q_rel
+        return self.yaw

@@ -1,0 +1,115 @@
+"""FOWT-layer golden parity tests.
+
+Mirrors the reference integration suite
+(/root/reference/tests/test_fowt.py): statics rollup, Morison added
+mass, strip-theory excitation over a 9x4x2 wave grid, drag
+linearization, and current loads for the VolturnUS-S and OC3spar
+designs, validated against the reference's inline literals and pickles
+at the same tolerances (rtol=1e-5).
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+import yaml
+from numpy.testing import assert_allclose
+
+from raft_tpu.core.fowt import FOWT
+
+from ref_goldens import load_literals
+
+LIST_FILES = ["VolturnUS-S.yaml", "OC3spar.yaml"]
+
+GOLDEN_NAMES = [
+    "desired_rCG", "desired_rCG_sub", "desired_m_ballast", "desired_M_struc",
+    "desired_M_struc_sub", "desired_C_struc", "desired_W_struc", "desired_rCB",
+    "desired_C_hydro", "desired_W_hydro", "desired_A_hydro_morison",
+    "desired_current_drag",
+]
+
+
+@pytest.fixture(scope="module")
+def goldens():
+    return load_literals("test_fowt.py", GOLDEN_NAMES)
+
+
+def _create_fowt(path):
+    with open(path) as f:
+        design = yaml.load(f, Loader=yaml.FullLoader)
+    min_freq = design["settings"]["min_freq"]
+    max_freq = design["settings"]["max_freq"]
+    w = np.arange(min_freq, max_freq + 0.5 * min_freq, min_freq) * 2 * np.pi
+    fowt = FOWT(design, w, depth=design["site"]["water_depth"])
+    fowt.setPosition(np.zeros(6))
+    fowt.calcStatics()
+    return fowt
+
+
+@pytest.fixture(scope="module", params=list(enumerate(LIST_FILES)), ids=[f[:-5] for f in LIST_FILES])
+def index_and_fowt(request, ref_test_data):
+    index, fname = request.param
+    return index, fname, _create_fowt(os.path.join(ref_test_data, fname))
+
+
+def test_statics(index_and_fowt, goldens):
+    index, _, fowt = index_and_fowt
+    assert_allclose(fowt.rCG, goldens["desired_rCG"][index], rtol=1e-05, atol=1e-3)
+    assert_allclose(fowt.rCG_sub, goldens["desired_rCG_sub"][index], rtol=1e-05, atol=1e-3)
+    assert_allclose(fowt.m_ballast, goldens["desired_m_ballast"][index], rtol=1e-05, atol=1e-3)
+    assert_allclose(fowt.M_struc, goldens["desired_M_struc"][index], rtol=1e-05, atol=1e-3)
+    assert_allclose(fowt.M_struc_sub, goldens["desired_M_struc_sub"][index], rtol=1e-05, atol=1e-3)
+    assert_allclose(fowt.C_struc, goldens["desired_C_struc"][index], rtol=1e-05, atol=1e-3)
+    assert_allclose(fowt.W_struc, goldens["desired_W_struc"][index], rtol=1e-05, atol=1e-3)
+    assert_allclose(fowt.rCB, goldens["desired_rCB"][index], rtol=1e-05, atol=1e-3)
+    assert_allclose(fowt.C_hydro, goldens["desired_C_hydro"][index], rtol=1e-05, atol=1e-3)
+    assert_allclose(fowt.W_hydro, goldens["desired_W_hydro"][index], rtol=1e-05, atol=1e-3)
+
+
+def test_hydro_constants(index_and_fowt, goldens):
+    index, _, fowt = index_and_fowt
+    fowt.calcHydroConstants()
+    assert_allclose(fowt.A_hydro_morison, goldens["desired_A_hydro_morison"][index], rtol=1e-05, atol=1e-3)
+
+
+def test_hydro_excitation(index_and_fowt, ref_test_data):
+    index, fname, fowt = index_and_fowt
+    with open(os.path.join(ref_test_data, fname.replace(".yaml", "_true_hydroExcitation.pkl")), "rb") as f:
+        true_values = pickle.load(f)
+
+    fowt.calcHydroConstants()
+    it = 0
+    for wave_heading in [0, 45, 90, 135, 180, 225, 270, 315, 360]:
+        for wave_period in [5, 10, 15, 20]:
+            for wave_height in [1, 2]:
+                case = {"wave_heading": wave_heading, "wave_period": wave_period, "wave_height": wave_height}
+                fowt.calcHydroExcitation(case, memberList=fowt.memberList)
+                assert_allclose(
+                    fowt.F_hydro_iner, true_values[it]["F_hydro_iner"], rtol=1e-05, atol=1e-3,
+                    err_msg=f"excitation mismatch for case {case}",
+                )
+                it += 1
+
+
+def test_hydro_linearization(index_and_fowt, ref_test_data):
+    index, fname, fowt = index_and_fowt
+    fowt.calcHydroConstants()
+    case = {"wave_spectrum": "unit", "wave_heading": 0, "wave_period": 10, "wave_height": 2}
+    fowt.calcHydroExcitation(case, memberList=fowt.memberList)
+
+    phase_array = np.linspace(0, 2 * np.pi, fowt.nw * 6).reshape(6, fowt.nw)
+    Xi = 0.1 * np.exp(1j * phase_array)
+    B_hydro_drag = fowt.calcHydroLinearization(Xi)
+    F_hydro_drag = fowt.calcDragExcitation(0)
+
+    with open(os.path.join(ref_test_data, fname.replace(".yaml", "_true_hydroLinearization.pkl")), "rb") as f:
+        true_values = pickle.load(f)
+    assert_allclose(B_hydro_drag, true_values["B_hydro_drag"], rtol=1e-05, atol=1e-10)
+    assert_allclose(F_hydro_drag, true_values["F_hydro_drag"], rtol=1e-05)
+
+
+def test_current_loads(index_and_fowt, goldens):
+    index, _, fowt = index_and_fowt
+    D = fowt.calcCurrentLoads({"current_speed": 2.0, "current_heading": 15})
+    assert_allclose(D, goldens["desired_current_drag"][index], rtol=1e-05, atol=1e-3)
