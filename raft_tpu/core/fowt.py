@@ -366,6 +366,7 @@ class FOWT:
         Sum_AWP_rWP = jnp.zeros(2)
         self.mtower = np.zeros(self.ntowers)
         self.rCG_tow = []
+        self._member_Mstruc = [None] * len(self.memberList)  # per-member 6x6 about PRP
 
         non_nacelle = [(i, cm) for i, cm in enumerate(self.memberList) if cm.topo.name != "nacelle"]
         for i, cm in non_nacelle:
@@ -373,6 +374,7 @@ class FOWT:
             self._poses[i] = pose
 
             Mm, mass, center, m_shell, mfill, _ = mstruct.member_inertia(cm.topo, cm.geom, pose, rPRP=prp)
+            self._member_Mstruc[i] = np.asarray(Mm)
             W_struc = W_struc + transforms.translate_force_3to6(
                 jnp.array([0.0, 0.0, -g]) * mass, center
             )
@@ -645,6 +647,195 @@ class FOWT:
             if rot.aeroServoMod > 0 and speed > 0.0:
                 from . import aero_interface
                 aero_interface.apply_rotor_aero(self, rot, ir, case, current, speed)
+
+    # ------------------------------------------------------------------
+    # potential flow (BEM)
+    # ------------------------------------------------------------------
+
+    def calcBEM(self, dw=0, wMax=0, wInf=10.0, dz=0, da=0, headings=[0], meshDir=None):
+        """First-order potential-flow coefficients (raft_fowt.py:568-717).
+
+        Strip-theory-only configurations (potModMaster 1 / no potMod
+        members) leave the BEM arrays zero, matching the reference.  The
+        WAMIT-file path (potModMaster 3) and the native panel BEM solver
+        land with the potential-flow module.
+        """
+        if not self.potMod:
+            return
+        raise NotImplementedError(
+            "potential-flow BEM path not yet available; use potModMaster=1 (strip theory)"
+        )
+
+    def calcQTF_slenderBody(self, waveHeadInd=0, Xi0=None, verbose=False, iCase=None, iWT=None):
+        """Slender-body QTF (raft_fowt.py:1385-1648) — second-order module."""
+        raise NotImplementedError(
+            "second-order hydro (potSecOrder) not yet available in raft_tpu"
+        )
+
+    def calcHydroForce_2ndOrd(self, beta, S0, iCase=None, iWT=None):
+        """Second-order force realization (raft_fowt.py:1728-1818)."""
+        raise NotImplementedError(
+            "second-order hydro (potSecOrder) not yet available in raft_tpu"
+        )
+
+    # ------------------------------------------------------------------
+    # output statistics
+    # ------------------------------------------------------------------
+
+    def saveTurbineOutputs(self, results, case):
+        """Response statistics for the current case (raft_fowt.py:1821-2109).
+
+        Fills the same ~70 channel names with identical semantics: RMS
+        summed across excitation sources, 3-sigma max/min, PSDs in
+        [unit]^2/(rad/s).
+        """
+        self.Xi0 = self.r6 - np.array([self.x_ref, self.y_ref, 0, 0, 0, 0])
+        Xi = self.Xi  # [nWaves+1, 6, nw]
+        dw = self.dw
+
+        def _rms(x):
+            return float(waves.rms(x))
+
+        def _psd(x):
+            return np.asarray(waves.psd(x, dw))
+
+        names = ["surge", "sway", "heave", "roll", "pitch", "yaw"]
+        for iDOF, name in enumerate(names):
+            if iDOF < 3:
+                resp = Xi[:, iDOF, :]
+                avg = self.Xi0[iDOF]
+            else:
+                resp = Xi[:, iDOF, :] * (180.0 / np.pi)
+                avg = np.rad2deg(self.Xi0[iDOF])
+            std = _rms(resp)
+            results[f"{name}_avg"] = avg
+            results[f"{name}_std"] = std
+            results[f"{name}_max"] = avg + 3 * std
+            results[f"{name}_min"] = avg - 3 * std
+            results[f"{name}_PSD"] = _psd(resp)
+            results[f"{name}_RA"] = resp
+
+        # FOWT-level mooring tension statistics (raft_fowt.py:1878-1898)
+        if self.ms is not None:
+            nLines = self.ms.n_lines
+            r6j = jnp.asarray(self.r6)
+            J_moor = np.asarray(moorsys.tension_jacobian(self.ms, self.ms.params, r6j))
+            T_moor = np.asarray(moorsys.tensions(self.ms, self.ms.params, r6j))
+            T_amps = np.einsum("td,hdw->htw", J_moor, Xi)
+            results["Tmoor_avg"] = T_moor
+            results["Tmoor_std"] = np.zeros(2 * nLines)
+            results["Tmoor_max"] = np.zeros(2 * nLines)
+            results["Tmoor_min"] = np.zeros(2 * nLines)
+            results["Tmoor_PSD"] = np.zeros([2 * nLines, self.nw])
+            for iT in range(2 * nLines):
+                TRMS = _rms(T_amps[:, iT, :])
+                results["Tmoor_std"][iT] = TRMS
+                results["Tmoor_max"][iT] = T_moor[iT] + 3 * TRMS
+                results["Tmoor_min"][iT] = T_moor[iT] - 3 * TRMS
+                results["Tmoor_PSD"][iT, :] = np.asarray(waves.psd(T_amps[:, iT, :], self.w[0]))
+
+        # hub fore-aft displacement/acceleration (planar approximation)
+        nr = self.nrotors
+        XiHub = np.zeros([Xi.shape[0], nr, self.nw], dtype=complex)
+        results["AxRNA_std"] = np.zeros(nr)
+        results["AxRNA_PSD"] = np.zeros([self.nw, nr])
+        results["AxRNA_avg"] = np.zeros(nr)
+        results["AxRNA_max"] = np.zeros(nr)
+        results["AxRNA_min"] = np.zeros(nr)
+        for ir, rotor in enumerate(self.rotorList):
+            XiHub[:, ir, :] = Xi[:, 0, :] + rotor.r_rel[2] * Xi[:, 4, :]
+            results["AxRNA_std"][ir] = _rms(XiHub[:, ir, :] * self.w**2)
+            results["AxRNA_PSD"][:, ir] = _psd(XiHub[:, ir, :] * self.w**2)
+            results["AxRNA_avg"][ir] = abs(np.sin(self.Xi0[4]) * 9.81)
+            results["AxRNA_max"][ir] = results["AxRNA_avg"][ir] + 3 * results["AxRNA_std"][ir]
+            results["AxRNA_min"][ir] = results["AxRNA_avg"][ir] - 3 * results["AxRNA_std"][ir]
+
+        # tower base bending moment (raft_fowt.py:1925-1981)
+        results["Mbase_avg"] = np.zeros(nr)
+        results["Mbase_std"] = np.zeros(nr)
+        results["Mbase_PSD"] = np.zeros([self.nw, nr])
+        results["Mbase_max"] = np.zeros(nr)
+        results["Mbase_min"] = np.zeros(nr)
+        for ir, rotor in enumerate(self.rotorList):
+            if ir >= len(self.mtower):
+                break
+            m_turbine = self.mtower[ir] + rotor.mRNA
+            zCG_turbine = (
+                self.rCG_tow[ir][2] * self.mtower[ir] + rotor.r_rel[2] * rotor.mRNA
+            ) / m_turbine
+            tower_pose = self._poses[self.nplatmems + ir]
+            zBase = float(np.asarray(tower_pose.rA)[2])
+            hArm = zCG_turbine - zBase
+
+            aCG_turbine = -self.w**2 * (Xi[:, 0, :] + zCG_turbine * Xi[:, 4, :])
+            M_tow = self._member_Mstruc[self.nplatmems + ir]
+            ICG_turbine = (
+                float(np.asarray(transforms.translate_matrix_6to6(
+                    jnp.asarray(M_tow), jnp.array([0.0, 0.0, -zCG_turbine])))[4, 4])
+                + rotor.mRNA * (rotor.r_rel[2] - zCG_turbine) ** 2 + rotor.IrRNA
+            )
+            M_I = -m_turbine * aCG_turbine * hArm - ICG_turbine * (-self.w**2 * Xi[:, 4, :])
+            M_w = m_turbine * self.g * hArm * Xi[:, 4, :]
+            M_X_aero = -(
+                -self.w**2 * self.A_aero[0, 0, :, ir]
+                + 1j * self.w * self.B_aero[0, 0, :, ir]
+            ) * (rotor.r_rel[2] - zBase) ** 2 * Xi[:, 4, :]
+            dynamic_moment = M_I + M_w + M_X_aero
+
+            results["Mbase_avg"][ir] = (
+                m_turbine * self.g * hArm * np.sin(self.Xi0[4])
+                + np.asarray(transforms.transform_force(
+                    jnp.asarray(self.f_aero0[:, ir]), offset=jnp.array([0.0, 0.0, -hArm])))[4]
+            )
+            results["Mbase_std"][ir] = _rms(dynamic_moment)
+            results["Mbase_PSD"][:, ir] = _psd(dynamic_moment)
+            results["Mbase_max"][ir] = results["Mbase_avg"][ir] + 3 * results["Mbase_std"][ir]
+            results["Mbase_min"][ir] = results["Mbase_avg"][ir] - 3 * results["Mbase_std"][ir]
+
+        results["wave_PSD"] = _psd(self.zeta)
+
+        # rotor aero-servo response channels (raft_fowt.py:1989-2085)
+        for key in ("omega_avg", "omega_std", "omega_max", "omega_min",
+                    "torque_avg", "torque_std", "power_avg",
+                    "bPitch_avg", "bPitch_std"):
+            results[key] = np.zeros(nr)
+        results["omega_PSD"] = np.zeros([self.nw, nr])
+        results["torque_PSD"] = np.zeros([self.nw, nr])
+        results["bPitch_PSD"] = np.zeros([self.nw, nr])
+
+        radps2rpm = 60.0 / (2.0 * np.pi)
+        for ir, rot in enumerate(self.rotorList):
+            if rot.r3[2] < 0:
+                speed = float(get_from_dict(case, "current_speed", shape=0, default=1.0))
+            else:
+                speed = float(get_from_dict(case, "wind_speed", shape=0, default=10.0))
+            if rot.aeroServoMod > 1 and speed > 0.0 and hasattr(rot, "C"):
+                nW = self.nWaves
+                phi_w = np.zeros([nW + 1, self.nw], dtype=complex)
+                for ih in range(nW):
+                    phi_w[ih, :] = rot.C * XiHub[ih, ir, :]
+                phi_w[-1, :] = rot.C * (XiHub[-1, ir, :] - rot.V_w / (1j * self.w))
+                omega_w = 1j * self.w * phi_w
+                torque_w = (1j * self.w * rot.kp_tau + rot.ki_tau) * phi_w
+                bPitch_w = (1j * self.w * rot.kp_beta + rot.ki_beta) * phi_w
+
+                results["omega_avg"][ir] = rot.Omega_case
+                results["omega_std"][ir] = radps2rpm * _rms(omega_w)
+                results["omega_max"][ir] = results["omega_avg"][ir] + 2 * results["omega_std"][ir]
+                results["omega_min"][ir] = results["omega_avg"][ir] - 2 * results["omega_std"][ir]
+                results["omega_PSD"][:, ir] = radps2rpm**2 * _psd(omega_w)
+                results["torque_avg"][ir] = rot.aero_torque / rot.Ng
+                results["torque_std"][ir] = _rms(torque_w)
+                results["torque_PSD"][:, ir] = _psd(torque_w)
+                results["power_avg"][ir] = rot.aero_power
+                results["bPitch_avg"][ir] = rot.pitch_case
+                results["bPitch_std"][ir] = np.rad2deg(_rms(bPitch_w))
+                results["bPitch_PSD"][:, ir] = np.rad2deg(1) ** 2 * _psd(bPitch_w)
+                results["wind_PSD"] = _psd(rot.V_w)
+
+            if rot.r3[2] < 0 and len(getattr(self, "cav", [])) > 0:
+                results["cavitation"] = self.cav
+        return results
 
     # ------------------------------------------------------------------
     # stiffness / eigen

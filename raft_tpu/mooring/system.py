@@ -12,7 +12,7 @@ Covers the MoorPy System capabilities the reference consumes
 - ``coupled_stiffness``   : -d F / d r6 by forward-mode AD (==
   getCoupledStiffnessA; MoorPy's finite-difference getCoupledStiffness
   is the same quantity);
-- ``tensions``            : line end tensions [TA1, TB1, TA2, ...] (==
+- ``tensions``            : line end tensions [TA1..TAN, TB1..TBN] (==
   System.getTensions ordering);
 - ``tension_jacobian``    : d tensions / d r6 (== the J_moor used for
   mooring-tension FFTs at raft_model.py:359).
@@ -44,6 +44,17 @@ from .catenary import line_end_forces
 _SEABED_TOL = 1.0e-3
 
 
+def _seabed_cb(lo_z: float, depth: float) -> float:
+    """Seabed-contact flag for a line: 0.0 when the lower end rests on the
+    seabed (catenary with bottom contact), -1.0 for free-hanging."""
+    return 0.0 if abs(lo_z + depth) < _SEABED_TOL else -1.0
+
+
+def _submerged_weight(diameter: float, mass_per_m: float, rho: float, g: float) -> float:
+    """Submerged weight per length from volume-equivalent diameter."""
+    return (mass_per_m - 0.25 * np.pi * diameter**2 * rho) * g
+
+
 @jax.tree_util.register_dataclass
 @dataclasses.dataclass
 class MooringParams:
@@ -61,7 +72,12 @@ class MooringParams:
 
 @dataclasses.dataclass(frozen=True)
 class CompiledMooring:
-    """Static topology + differentiable parameters for one mooring system."""
+    """Static topology + differentiable parameters for one mooring system.
+
+    ``p_body`` generalizes to multi-body (array/farm) systems: for each
+    point it holds the index of the coupled body it rides (-1 = world
+    point).  Single-FOWT systems have every coupled point on body 0.
+    """
 
     n_points: int
     n_lines: int
@@ -70,6 +86,14 @@ class CompiledMooring:
     line_iB: Tuple[int, ...]
     free_idx: Tuple[int, ...]  # indices of free points
     params: MooringParams
+    p_body: Tuple[int, ...] = ()
+    n_bodies: int = 1
+
+    def __post_init__(self):
+        if not self.p_body:
+            object.__setattr__(
+                self, "p_body", tuple(0 if k == -1 else -1 for k in self.p_kind)
+            )
 
     @property
     def has_free(self) -> bool:
@@ -116,18 +140,13 @@ def compile_mooring(mooring: dict, x_ref: float = 0.0, y_ref: float = 0.0,
     for ln in mooring["lines"]:
         a, b = idx[ln["endA"]], idx[ln["endB"]]
         lt = ltypes[ln["type"]]
-        d_vol = float(lt["diameter"])
-        mden = float(lt["mass_density"])
-        w_sub = (mden - 0.25 * np.pi * d_vol**2 * rho) * g
         iA.append(a)
         iB.append(b)
         Ls.append(float(ln["length"]))
         EAs.append(float(lt["stiffness"]))
-        ws.append(w_sub)
+        ws.append(_submerged_weight(float(lt["diameter"]), float(lt["mass_density"]), rho, g))
         # seabed contact only when the line's lower end sits on the seabed
-        zA, zB = locs[a][2], locs[b][2]
-        lo_z = min(zA, zB)
-        cbs.append(0.0 if abs(lo_z + depth) < _SEABED_TOL else -1.0)
+        cbs.append(_seabed_cb(min(locs[a][2], locs[b][2]), depth))
 
     # reference-position transform (raft_fowt.py:185): rotate about z then shift
     th = np.deg2rad(heading_adjust)
@@ -168,17 +187,24 @@ def compile_mooring(mooring: dict, x_ref: float = 0.0, y_ref: float = 0.0,
 
 
 def point_positions(ms: CompiledMooring, params: MooringParams, r6, free_xyz=None):
-    """World positions of every point for body pose ``r6``.
+    """World positions of every point for body pose(s) ``r6``.
 
-    Coupled points ride the body rigidly (MoorPy Body.setPosition uses
-    the same large-angle rotation matrix as the platform members).
+    ``r6`` is [6] (single body) or [nB,6].  Coupled points ride their
+    body rigidly (MoorPy Body.setPosition uses the same large-angle
+    rotation matrix as the platform members).
     """
-    r6 = jnp.asarray(r6)
-    R = transforms.rotation_matrix(r6[3:])
-    kinds = np.array(ms.p_kind)
-    coupled = jnp.asarray(kinds == -1)[:, None]
+    r6s = jnp.atleast_2d(jnp.asarray(r6))  # [nB,6]
+    if r6s.shape[0] != ms.n_bodies:
+        raise ValueError(
+            f"pose array has {r6s.shape[0]} bodies but mooring system couples "
+            f"{ms.n_bodies} (JAX index clamping would silently misattach points)"
+        )
+    Rs = jax.vmap(transforms.rotation_matrix)(r6s[:, 3:])  # [nB,3,3]
+    body_of = np.array(ms.p_body)
+    bidx = jnp.asarray(np.clip(body_of, 0, None))
+    coupled = jnp.asarray(body_of >= 0)[:, None]
     world = params.p_loc
-    body = r6[:3][None, :] + params.p_loc @ R.T
+    body = r6s[bidx, :3] + jnp.einsum("nij,nj->ni", Rs[bidx], params.p_loc)
     pos = jnp.where(coupled, body, world)
     if free_xyz is not None and ms.has_free:
         pos = pos.at[jnp.array(ms.free_idx)].set(free_xyz)
@@ -294,24 +320,29 @@ def _equilibrium_positions(ms: CompiledMooring, params: MooringParams, r6):
     return point_positions(ms, params, r6)
 
 
+def _bodies_forces(ms: CompiledMooring, params: MooringParams, r6s):
+    """Net 6-DOF line force/moment on every coupled body. r6s [nB,6] -> [nB,6]."""
+    r6s = jnp.atleast_2d(jnp.asarray(r6s))
+    pos = _equilibrium_positions(ms, params, r6s)
+    F_A, F_B, _, _ = _line_forces_at_points(ms, params, pos)
+
+    nB = ms.n_bodies
+    body_of = np.array(ms.p_body)
+    out = jnp.zeros((nB + 1, 6), dtype=pos.dtype)  # last row: spill for world points
+    for idx_pts, F in ((ms.line_iA, F_A), (ms.line_iB, F_B)):
+        pts = np.array(idx_pts)
+        b = body_of[pts]
+        tgt = jnp.asarray(np.where(b >= 0, b, nB))
+        offs = pos[jnp.asarray(pts)] - r6s[jnp.asarray(np.clip(b, 0, None)), :3]
+        F6 = transforms.translate_force_3to6(F, offs)
+        out = out.at[tgt].add(F6)
+    return out[:nB]
+
+
 def body_forces(ms: CompiledMooring, params: MooringParams, r6):
     """Net 6-DOF mooring force/moment on the coupled body at pose r6,
     moments about the body origin (== Body.getForces(lines_only=True))."""
-    r6 = jnp.asarray(r6)
-    pos = _equilibrium_positions(ms, params, r6)
-    F_A, F_B, _, _ = _line_forces_at_points(ms, params, pos)
-
-    kinds = np.array(ms.p_kind)
-    iA = np.array(ms.line_iA)
-    iB = np.array(ms.line_iB)
-    onbodyA = jnp.asarray((kinds[iA] == -1).astype(float))
-    onbodyB = jnp.asarray((kinds[iB] == -1).astype(float))
-
-    offsA = pos[jnp.array(ms.line_iA)] - r6[:3]
-    offsB = pos[jnp.array(ms.line_iB)] - r6[:3]
-    F6_A = transforms.translate_force_3to6(F_A, offsA) * onbodyA[:, None]
-    F6_B = transforms.translate_force_3to6(F_B, offsB) * onbodyB[:, None]
-    return jnp.sum(F6_A, axis=0) + jnp.sum(F6_B, axis=0)
+    return _bodies_forces(ms, params, jnp.asarray(r6)[None, :])[0]
 
 
 def coupled_stiffness(ms: CompiledMooring, params: MooringParams, r6):
@@ -321,17 +352,164 @@ def coupled_stiffness(ms: CompiledMooring, params: MooringParams, r6):
 
 
 def tensions(ms: CompiledMooring, params: MooringParams, r6):
-    """Line end tensions [TA_1, TB_1, TA_2, TB_2, ...] at equilibrium
+    """Line end tensions [TA_1..TA_N, TB_1..TB_N] at equilibrium
     (== System.getTensions ordering, consumed at raft_fowt.py:1882)."""
     pos = _equilibrium_positions(ms, params, jnp.asarray(r6))
     _, _, TA, TB = _line_forces_at_points(ms, params, pos)
-    return jnp.stack([TA, TB], axis=1).reshape(-1)
+    return jnp.concatenate([TA, TB])
 
 
 def tension_jacobian(ms: CompiledMooring, params: MooringParams, r6):
     """d(tensions)/d(r6) — the J_moor used for tension FFTs
     (raft_model.py:353-359)."""
     return jax.jacfwd(lambda r: tensions(ms, params, r))(jnp.asarray(r6))
+
+
+# ---------------------------------------------------------------------------
+# array-level (multi-body / farm) interface — replaces the reference's
+# array-level MoorPy System (raft_model.py:83-100, 1030-1031)
+# ---------------------------------------------------------------------------
+
+
+def array_body_forces(ms: CompiledMooring, r6s):
+    """Net line forces on all bodies, flattened [6*nB]
+    (== ms.bodyList[i].getForces(lines_only=True) stacked)."""
+    return _bodies_forces(ms, ms.params, jnp.asarray(r6s)).reshape(-1)
+
+
+def array_coupled_stiffness(ms: CompiledMooring, r6s):
+    """[6nB,6nB] stiffness -dF/dX of the array mooring system
+    (== getCoupledStiffnessA(lines_only=True))."""
+    r6s = jnp.asarray(r6s)
+    shp = r6s.shape
+
+    def f(xflat):
+        return array_body_forces(ms, xflat.reshape(shp))
+
+    return -jax.jacfwd(f)(r6s.reshape(-1))
+
+
+def array_tensions(ms: CompiledMooring, r6s):
+    """Line end tensions [TA_1..TA_N, TB_1..TB_N] for the array system."""
+    pos = _equilibrium_positions(ms, ms.params, jnp.atleast_2d(jnp.asarray(r6s)))
+    _, _, TA, TB = _line_forces_at_points(ms, ms.params, pos)
+    return jnp.concatenate([TA, TB])
+
+
+def array_tension_jacobian(ms: CompiledMooring, r6s):
+    """d tensions / d X [2*n_lines, 6nB] (== J_moor, raft_model.py:353)."""
+    r6s = jnp.asarray(r6s)
+    shp = r6s.shape
+
+    def f(xflat):
+        return array_tensions(ms, xflat.reshape(shp))
+
+    return jax.jacfwd(f)(r6s.reshape(-1))
+
+
+def compile_moordyn_file(path: str, depth: float, body_coords=None,
+                         rho=RHO_WATER, g=GRAVITY) -> CompiledMooring:
+    """Parse a MoorDyn v2 input file into a multi-body CompiledMooring.
+
+    Covers the array/farm shared-mooring path the reference delegates to
+    ``mp.System.load`` (raft_model.py:96-100): LINE TYPES, POINTS
+    (attachments 'TurbineN'/'BodyN' -> coupled body N-1, body-frame
+    coords; 'Free'; 'Fixed'), LINES, and the WtrDpth option.  Dynamics-
+    only fields (BA, EI, NumSegs, dtM, ...) are ignored, as the
+    quasi-static model has no use for them.
+    """
+    with open(path) as f:
+        raw_lines = [ln.rstrip("\n") for ln in f]
+
+    sections: dict[str, list[str]] = {}
+    current = None
+    for ln in raw_lines:
+        s = ln.strip()
+        if s.startswith("---"):
+            up = s.upper()
+            for name in ("LINE TYPES", "POINTS", "LINES", "OPTIONS", "BODIES",
+                         "RODS", "ROD TYPES", "OUTPUTS"):
+                if name in up:
+                    current = name
+                    sections[current] = []
+                    break
+            else:
+                current = None
+            continue
+        if current is not None and s:
+            sections[current].append(s)
+
+    def data_rows(name):
+        rows = sections.get(name, [])
+        # drop the two header rows (names + units)
+        return [r.split("#")[0].split() for r in rows[2:] if r.split("#")[0].strip()]
+
+    for ln in sections.get("OPTIONS", []):
+        parts = ln.split()
+        if len(parts) >= 2 and parts[1].lower() in ("wtrdpth", "depth", "wtrdepth"):
+            depth = float(parts[0])
+
+    ltypes = {}
+    for p in data_rows("LINE TYPES"):
+        ltypes[p[0]] = {"d": float(p[1]), "m": float(p[2]), "EA": float(p[3])}
+
+    names, kinds, bodies, locs, masses, vols = [], [], [], [], [], []
+    id_map = {}
+    for p in data_rows("POINTS"):
+        pid = p[0]
+        att = p[1].lower()
+        if att.startswith(("turbine", "body", "vessel", "coupled")):
+            kind = -1
+            digits = "".join(ch for ch in att if ch.isdigit())
+            body = int(digits) - 1 if digits else 0
+        elif att.startswith(("fix", "anchor")):
+            kind, body = 1, -1
+        else:  # free / connect
+            kind, body = 0, -1
+        id_map[pid] = len(names)
+        names.append(pid)
+        kinds.append(kind)
+        bodies.append(body)
+        locs.append(np.array([float(p[2]), float(p[3]), float(p[4])]))
+        masses.append(float(p[5]) if len(p) > 5 else 0.0)
+        vols.append(float(p[6]) if len(p) > 6 else 0.0)
+
+    iA, iB, Ls, EAs, ws, cbs = [], [], [], [], [], []
+    for p in data_rows("LINES"):
+        lt = ltypes[p[1]]
+        a, b = id_map[p[2]], id_map[p[3]]
+        iA.append(a)
+        iB.append(b)
+        Ls.append(float(p[4]))
+        EAs.append(lt["EA"])
+        ws.append(_submerged_weight(lt["d"], lt["m"], rho, g))
+        cbs.append(_seabed_cb(min(locs[a][2], locs[b][2]), depth))
+
+    n_bodies = (max((b for b in bodies if b >= 0), default=-1) + 1)
+    if body_coords is not None:
+        n_bodies = max(n_bodies, len(body_coords))
+
+    params = MooringParams(
+        p_loc=jnp.asarray(np.array(locs)),
+        p_mass=jnp.asarray(np.array(masses)),
+        p_vol=jnp.asarray(np.array(vols)),
+        L=jnp.asarray(np.array(Ls)),
+        EA=jnp.asarray(np.array(EAs)),
+        w=jnp.asarray(np.array(ws)),
+        cb=jnp.asarray(np.array(cbs)),
+        depth=jnp.asarray(float(depth)),
+    )
+    return CompiledMooring(
+        n_points=len(names),
+        n_lines=len(Ls),
+        p_kind=tuple(kinds),
+        line_iA=tuple(iA),
+        line_iB=tuple(iB),
+        free_idx=tuple(i for i, k in enumerate(kinds) if k == 0),
+        params=params,
+        p_body=tuple(bodies),
+        n_bodies=n_bodies,
+    )
 
 
 def fairlead_forces(ms: CompiledMooring, params: MooringParams, r6):

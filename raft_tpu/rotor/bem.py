@@ -1,0 +1,342 @@
+"""Blade-element-momentum rotor solver in JAX (CCBlade-equivalent).
+
+Replaces the reference's Fortran-backed CCBlade dependency
+(/root/reference/raft/raft_rotor.py:18-20, 332-363, 699-767) with a
+pure-JAX implementation of the same model: Ning (2014) single-residual
+BEM with Prandtl tip/hub losses, Buhl high-induction correction, drag
+in the induction factors, power-law inflow shear, and shaft tilt / yaw
+/ precone / precurve geometry, averaged over azimuthal sectors.
+
+TPU mapping: the per-(element, azimuth) residual solve is a fixed-count
+bisection inside ``vmap`` — no data-dependent control flow — so one
+``evaluate`` jits to a single fused kernel, and operating-point
+derivatives (the dT/dU, dQ/dOmega, dQ/dpitch Jacobians RAFT consumes)
+come from ``jax.jacfwd`` instead of the Fortran adjoints.  Everything
+batches over operating points for the power-curve / FLORIS layers.
+"""
+
+from __future__ import annotations
+
+import dataclasses
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+_EPS = 1.0e-6
+
+
+@jax.tree_util.register_dataclass
+@dataclasses.dataclass
+class BEMRotor:
+    """Compiled rotor description for the BEM solver (all jnp arrays).
+
+    Polars are dense per-element tables over ``aoa_grid`` [rad], sampled
+    on the host from the same spline pipeline the reference feeds
+    CCAirfoil, so device-side lookup is a linear gather.
+    """
+
+    r: jnp.ndarray  # [nr] span stations (along blade axis) [m]
+    chord: jnp.ndarray  # [nr]
+    theta: jnp.ndarray  # [nr] twist [rad]
+    precurve: jnp.ndarray  # [nr] x offsets [m]
+    presweep: jnp.ndarray  # [nr] y offsets [m]
+    Rhub: jnp.ndarray  # []
+    Rtip: jnp.ndarray  # []
+    precurve_tip: jnp.ndarray  # []
+    presweep_tip: jnp.ndarray  # []
+    hub_height: jnp.ndarray  # []
+    precone: jnp.ndarray  # [] [rad]
+    rho: jnp.ndarray  # []
+    mu: jnp.ndarray  # []
+    shear_exp: jnp.ndarray  # []
+    aoa_grid: jnp.ndarray  # [na] angle of attack [rad], uniform
+    cl_tab: jnp.ndarray  # [nr, na]
+    cd_tab: jnp.ndarray  # [nr, na]
+    cpmin_tab: jnp.ndarray  # [nr, na] (zeros when unavailable)
+
+    # static (non-pytree) fields
+    n_blades: int = dataclasses.field(metadata=dict(static=True), default=3)
+    n_sector: int = dataclasses.field(metadata=dict(static=True), default=4)
+
+
+def _interp_polar(tab, aoa_grid, alpha):
+    """Linear lookup in a dense uniform polar table."""
+    a0 = aoa_grid[0]
+    da = aoa_grid[1] - aoa_grid[0]
+    x = (alpha - a0) / da
+    i = jnp.clip(jnp.floor(x).astype(jnp.int32), 0, tab.shape[-1] - 2)
+    t = x - i
+    return tab[i] * (1.0 - t) + tab[i + 1] * t
+
+
+def _induction(phi, k, kp, F):
+    """Axial/tangential induction from the Ning-2014 parameterization with
+    Buhl's empirical correction in the windmill-brake region."""
+    # momentum / empirical regions (phi > 0)
+    a_mom = k / (1.0 + k)
+    g1 = 2.0 * F * k - (10.0 / 9.0 - F)
+    g2 = 2.0 * F * k - F * (4.0 / 3.0 - F)
+    g3 = 2.0 * F * k - (25.0 / 9.0 - 2.0 * F)
+    g2 = jnp.maximum(g2, 1e-12)
+    a_buhl = jnp.where(
+        jnp.abs(g3) < 1e-6,
+        1.0 - 1.0 / (2.0 * jnp.sqrt(g2)),
+        (g1 - jnp.sqrt(g2)) / jnp.where(jnp.abs(g3) < 1e-6, 1.0, g3),
+    )
+    a_pos = jnp.where(k <= 2.0 / 3.0, a_mom, a_buhl)
+    # propeller-brake region (phi < 0)
+    a_neg = jnp.where(k > 1.0, k / (k - 1.0), 0.0)
+    a = jnp.where(phi > 0.0, a_pos, a_neg)
+    ap = kp / (1.0 - kp)
+    return a, ap
+
+
+def _phi_residual(phi, Vx, Vy, r_i, chord_i, theta_i, pitch, geom):
+    """Ning (2014) single residual R(phi); also returns loads ingredients."""
+    sphi = jnp.sin(phi)
+    cphi = jnp.cos(phi)
+    alpha = phi - (theta_i + pitch)
+
+    cl = _interp_polar(geom.cl_tab_i, geom.aoa_grid, alpha)
+    cd = _interp_polar(geom.cd_tab_i, geom.aoa_grid, alpha)
+
+    cn = cl * cphi + cd * sphi
+    ct = cl * sphi - cd * cphi
+
+    # Prandtl tip/hub loss
+    B = geom.n_blades
+    sabs = jnp.maximum(jnp.abs(sphi), 1e-9)
+    ftip = B / 2.0 * (geom.Rtip - r_i) / (r_i * sabs)
+    Ftip = 2.0 / jnp.pi * jnp.arccos(jnp.clip(jnp.exp(-ftip), -1.0, 1.0))
+    fhub = B / 2.0 * (r_i - geom.Rhub) / (geom.Rhub * sabs)
+    Fhub = 2.0 / jnp.pi * jnp.arccos(jnp.clip(jnp.exp(-fhub), -1.0, 1.0))
+    F = jnp.maximum(Ftip * Fhub, 1e-9)
+
+    sigma_p = B * chord_i / (2.0 * jnp.pi * r_i)
+    k = sigma_p * cn / (4.0 * F * sphi * sphi)
+    kp = sigma_p * ct / (4.0 * F * sphi * cphi)
+
+    a, ap = _induction(phi, k, kp, F)
+
+    lam = Vy / Vx  # local inflow ratio
+    R = sphi / (1.0 - a) - cphi / (lam * (1.0 + ap))
+    return R, (a, ap, cl, cd, cn, ct, F)
+
+
+class _ElemGeom:
+    """Tiny per-element view passed through the residual (keeps the
+    vmapped residual signature flat)."""
+
+    __slots__ = ("cl_tab_i", "cd_tab_i", "aoa_grid", "Rtip", "Rhub", "n_blades")
+
+    def __init__(self, rotor: BEMRotor, cl_i, cd_i):
+        self.cl_tab_i = cl_i
+        self.cd_tab_i = cd_i
+        self.aoa_grid = rotor.aoa_grid
+        self.Rtip = rotor.Rtip
+        self.Rhub = rotor.Rhub
+        self.n_blades = rotor.n_blades
+
+
+def _solve_element(Vx, Vy, r_i, chord_i, theta_i, pitch, rotor, cl_i, cd_i, n_iter=96):
+    """Bracketed bisection on R(phi) following CCBlade's strategy:
+    try (eps, pi/2]; if no sign change, (-pi/4, -eps); else (pi/2, pi-eps)."""
+    geom = _ElemGeom(rotor, cl_i, cd_i)
+
+    def resid(phi):
+        return _phi_residual(phi, Vx, Vy, r_i, chord_i, theta_i, pitch, geom)[0]
+
+    eps = _EPS
+    r_lo1 = resid(eps)
+    r_hi1 = resid(jnp.pi / 2.0)
+    r_lo2 = resid(-jnp.pi / 4.0)
+    r_hi2 = resid(-eps)
+    use1 = r_lo1 * r_hi1 <= 0.0
+    use2 = (~use1) & (r_lo2 * r_hi2 < 0.0)
+
+    lo = jnp.where(use1, eps, jnp.where(use2, -jnp.pi / 4.0, jnp.pi / 2.0))
+    hi = jnp.where(use1, jnp.pi / 2.0, jnp.where(use2, -eps, jnp.pi - eps))
+    f_lo = jnp.where(use1, r_lo1, jnp.where(use2, r_lo2, r_hi1))
+
+    def body(_, state):
+        lo, hi, f_lo = state
+        mid = 0.5 * (lo + hi)
+        f_mid = resid(mid)
+        take_lo = f_lo * f_mid <= 0.0
+        return (
+            jnp.where(take_lo, lo, mid),
+            jnp.where(take_lo, mid, hi),
+            jnp.where(take_lo, f_lo, f_mid),
+        )
+
+    lo, hi, _ = jax.lax.fori_loop(0, n_iter, body, (lo, hi, f_lo))
+    phi = 0.5 * (lo + hi)
+    _, (a, ap, cl, cd, cn, ct, F) = _phi_residual(
+        phi, Vx, Vy, r_i, chord_i, theta_i, pitch, geom
+    )
+    return phi, a, ap, cn, ct
+
+
+def _distributed_loads(rotor: BEMRotor, Uinf, Omega, pitch, azimuth, tilt, yaw):
+    """Np, Tp [N/m] along the span for one blade at one azimuth angle.
+
+    Geometry/conventions follow CCBlade: power-law shear from hub
+    height, yaw about z, tilt about y, azimuth about the shaft axis,
+    total cone = precone + local precurve slope.
+    """
+    r = rotor.r
+    precurve = rotor.precurve
+    presweep = rotor.presweep
+
+    # local total cone angle from precurve slope (CCBlade definedCurvature)
+    dcurve = jnp.gradient(precurve) / jnp.gradient(r)
+    cone = rotor.precone + jnp.arctan(dcurve)
+
+    sPC, cPC = jnp.sin(rotor.precone), jnp.cos(rotor.precone)
+    x_az = -r * sPC + precurve * cPC
+    z_az = r * cPC + precurve * sPC
+    y_az = presweep
+
+    sy, cy = jnp.sin(yaw), jnp.cos(yaw)
+    st, ct = jnp.sin(tilt), jnp.cos(tilt)
+    sa, ca = jnp.sin(azimuth), jnp.cos(azimuth)
+    sc, cc = jnp.sin(cone), jnp.cos(cone)
+
+    # element height above hub -> sheared inflow speed
+    height = (y_az * sa + z_az * ca) * ct - x_az * st
+    V = Uinf * jnp.power(jnp.maximum((rotor.hub_height + height) / rotor.hub_height, 1e-3),
+                         rotor.shear_exp)
+
+    # wind components in the local blade frame
+    Vwind_x = V * ((cy * st * ca + sy * sa) * sc + cy * ct * cc)
+    Vwind_y = V * (cy * st * sa - sy * ca)
+    # rotational speed contribution
+    Vrot_x = -Omega * y_az * sc
+    Vrot_y = Omega * z_az
+
+    Vx = Vwind_x + Vrot_x
+    Vy = Vwind_y + Vrot_y
+    Vy = jnp.where(jnp.abs(Vy) < 1e-6, 1e-6, Vy)
+    Vx = jnp.where(jnp.abs(Vx) < 1e-6, 1e-6, Vx)
+
+    phi, a, ap, cn, ct_c = jax.vmap(
+        lambda vx, vy, ri, ci, ti, cli, cdi: _solve_element(
+            vx, vy, ri, ci, ti, pitch, rotor, cli, cdi
+        )
+    )(Vx, Vy, r, rotor.chord, rotor.theta, rotor.cl_tab, rotor.cd_tab)
+
+    W2 = (Vx * (1.0 - a)) ** 2 + (Vy * (1.0 + ap)) ** 2
+    q = 0.5 * rotor.rho * W2 * rotor.chord
+    Np = cn * q
+    Tp = ct_c * q
+    return Np, Tp, cone, x_az, y_az, z_az
+
+
+def _integrate_hub_loads(rotor: BEMRotor, Np, Tp, cone, x_az, y_az, z_az, azimuth):
+    """Integrate one blade's distributed loads into hub-frame forces and
+    moments (about the hub center), with zero-load endpoints at
+    Rhub/Rtip like CCBlade's thrusttorque."""
+    sPC, cPC = jnp.sin(rotor.precone), jnp.cos(rotor.precone)
+
+    # endpoint coordinates
+    x0 = -rotor.Rhub * sPC + rotor.precurve[0] * cPC
+    z0 = rotor.Rhub * cPC + rotor.precurve[0] * sPC
+    x1 = -rotor.Rtip * sPC + rotor.precurve_tip * cPC
+    z1 = rotor.Rtip * cPC + rotor.precurve_tip * sPC
+
+    def ext(v, v0, v1):
+        return jnp.concatenate([jnp.array([v0]), v, jnp.array([v1])])
+
+    r_e = ext(rotor.r, rotor.Rhub, rotor.Rtip)
+    Np_e = ext(Np, 0.0, 0.0)
+    Tp_e = ext(Tp, 0.0, 0.0)
+    cone_e = ext(cone, cone[0], cone[-1])
+    x_e = ext(x_az, x0, x1)
+    y_e = ext(y_az, rotor.presweep[0], rotor.presweep_tip)
+    z_e = ext(z_az, z0, z1)
+
+    # force per unit span in the azimuth frame (rotate blade->azimuth by cone)
+    fx = Np_e * jnp.cos(cone_e)
+    fz = -Np_e * jnp.sin(cone_e)
+    fy = Tp_e
+
+    def trapz(y):
+        return jnp.sum(0.5 * (y[1:] + y[:-1]) * jnp.diff(r_e))
+
+    Fx = trapz(fx)
+    Fy = trapz(fy)
+    Fz = trapz(fz)
+    # moments about hub center: M = ∫ p × f
+    Mx = trapz(y_e * fz - z_e * fy)
+    My = trapz(z_e * fx - x_e * fz)
+    Mz = trapz(x_e * fy - y_e * fx)
+
+    # rotate azimuth frame -> hub frame (rotation about shaft x by -azimuth)
+    sa, ca = jnp.sin(azimuth), jnp.cos(azimuth)
+
+    def rot(vy, vz):
+        return vy * sa + vz * ca, -vy * ca + vz * sa
+
+    # blade azimuth measured from vertical-up, rotor spins so that the
+    # y-z components map as below (calibrated against CCBlade goldens)
+    Fy_h, Fz_h = ca * Fy + sa * Fz, -sa * Fy + ca * Fz
+    My_h, Mz_h = ca * My + sa * Mz, -sa * My + ca * Mz
+    return jnp.array([Fx, Fy_h, Fz_h, -Mx, My_h, Mz_h])
+
+
+def evaluate(rotor: BEMRotor, Uinf, Omega_radps, pitch_rad, tilt=0.0, yaw=0.0):
+    """Rotor loads at one operating point (CCBlade.evaluate equivalent).
+
+    Returns a dict with hub loads T, Y, Z, Q, My, Mz [N, N·m], power P,
+    and nondimensional coefficients; all averaged over ``n_sector``
+    azimuth positions.  Inputs in SI/rad.
+    """
+    azimuths = jnp.arange(rotor.n_sector) * (2.0 * jnp.pi / rotor.n_sector)
+
+    def one_azimuth(az):
+        Np, Tp, cone, x_az, y_az, z_az = _distributed_loads(
+            rotor, Uinf, Omega_radps, pitch_rad, az, tilt, yaw
+        )
+        return _integrate_hub_loads(rotor, Np, Tp, cone, x_az, y_az, z_az, az)
+
+    loads = jax.vmap(one_azimuth)(azimuths)  # [nsec, 6]
+    F = rotor.n_blades * jnp.mean(loads, axis=0)
+
+    T = F[0]
+    Q = -F[3]  # torque about shaft; sign so that driving torque is positive
+    P = Q * Omega_radps
+
+    rho = rotor.rho
+    A = jnp.pi * rotor.Rtip**2
+    q_dyn = 0.5 * rho * Uinf**2
+    out = {
+        "T": T, "Y": F[1], "Z": F[2], "Q": Q, "My": F[4], "Mz": F[5], "P": P,
+        "CP": P / (q_dyn * A * Uinf),
+        "CT": T / (q_dyn * A),
+        "CQ": Q / (q_dyn * rotor.Rtip * A),
+        "CY": F[1] / (q_dyn * A),
+        "CZ": F[2] / (q_dyn * A),
+        "CMy": F[4] / (q_dyn * rotor.Rtip * A),
+        "CMz": F[5] / (q_dyn * rotor.Rtip * A),
+    }
+    return out
+
+
+def evaluate_with_derivatives(rotor: BEMRotor, Uinf, Omega_radps, pitch_rad,
+                              tilt=0.0, yaw=0.0):
+    """Loads plus exact Jacobians dT/d(U, Omega, pitch) and dQ/d(...)
+    via forward-mode AD (replaces CCBlade's Fortran derivatives)."""
+
+    def tq(x):
+        out = evaluate(rotor, x[0], x[1], x[2], tilt=tilt, yaw=yaw)
+        return jnp.array([out["T"], out["Q"]])
+
+    x0 = jnp.array([Uinf, Omega_radps, pitch_rad])
+    J = jax.jacfwd(tq)(x0)
+    out = evaluate(rotor, Uinf, Omega_radps, pitch_rad, tilt=tilt, yaw=yaw)
+    derivs = {
+        "dT_dU": J[0, 0], "dT_dOmega": J[0, 1], "dT_dpitch": J[0, 2],
+        "dQ_dU": J[1, 0], "dQ_dOmega": J[1, 1], "dQ_dpitch": J[1, 2],
+    }
+    return out, derivs

@@ -1,0 +1,113 @@
+"""IEC 61400-1 wind models (pyIECWind-equivalent) + rotor-averaged Kaimal.
+
+Host-side NumPy/SciPy: these produce per-case spectra that feed the
+traced aero kernels as inputs; nothing here sits inside a jit region.
+Covers the reference's pyIECWind_extreme sigma models
+(/root/reference/raft/pyIECWind.py:8-77) and Rotor.IECKaimal
+(/root/reference/raft/raft_rotor.py:1125-1223).  The transient event
+time series (EOG/EDC/ECD/EWS, pyIECWind.py:79-420) are in
+``extreme_event`` below.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from scipy.special import modstruve, iv
+
+
+class IECWindExtreme:
+    """IEC 61400-1 turbine/turbulence class parameters and sigma models."""
+
+    def __init__(self):
+        self.Turbine_Class = "I"
+        self.Turbulence_Class = "B"
+        self.z_hub = 90.0
+        self.D = 126.0
+        self.I_ref = 0.14
+        self.V_ref = 50.0
+        self.V_ave = 10.0
+        self.Sigma_1 = 42.0
+
+    def setup(self):
+        self.V_ref = {"I": 50.0, "II": 42.5, "III": 37.5, "IV": 30.0}[self.Turbine_Class]
+        self.V_ave = self.V_ref * 0.2
+        self.I_ref = {"A+": 0.18, "A": 0.16, "B": 0.14, "C": 0.12}[self.Turbulence_Class]
+        self.Sigma_1 = 42.0 if self.z_hub > 60 else 0.7 * self.z_hub
+
+    def NTM(self, V_hub):
+        return self.I_ref * (0.75 * V_hub + 5.6)
+
+    def ETM(self, V_hub):
+        c = 2.0
+        return c * self.I_ref * (0.072 * (self.V_ave / c + 3) * (V_hub / c - 4) + 10)
+
+    def EWM(self, V_hub):
+        V_e50 = 1.4 * self.V_ref
+        return 0.11 * V_hub, V_e50, 0.8 * V_e50, self.V_ref, 0.8 * self.V_ref
+
+
+def kaimal_rotor_spectra(w, speed, turbulence, hub_height, R):
+    """Rotor-averaged Kaimal turbulence PSD over angular frequencies ``w``.
+
+    Mirrors Rotor.IECKaimal: turbulence is either a float TI or a string
+    like 'IB_NTM'.  Returns (U, V, W, Rot) PSDs [(m/s)^2 / (rad/s)]...
+    strictly the reference returns them per-Hz-based f arrays; semantics
+    kept identical (raft_rotor.py:1211-1223).
+    """
+    f = np.asarray(w) / (2.0 * np.pi)
+    HH = abs(hub_height)
+    V_ref = speed
+
+    iec = IECWindExtreme()
+    iec.z_hub = HH
+
+    TurbMod = "NTM"
+    if isinstance(turbulence, str):
+        Class = ""
+        for char in turbulence:
+            if char in ("I", "V"):
+                Class += char
+            else:
+                break
+        if not Class:
+            turbulence = float(turbulence)
+        else:
+            iec.Turbulence_Class = char
+            try:
+                TurbMod = turbulence.split("_")[1]
+            except IndexError:
+                raise Exception(f"Error reading the turbulence model: {turbulence}")
+            iec.Turbine_Class = Class
+
+    iec.setup()
+    if isinstance(turbulence, (int, float)):
+        iec.I_ref = float(turbulence)
+        TurbMod = "NTM"
+
+    if TurbMod == "NTM":
+        sigma_1 = iec.NTM(V_ref)
+    elif TurbMod == "ETM":
+        sigma_1 = iec.ETM(V_ref)
+    elif TurbMod == "EWM":
+        sigma_1 = iec.EWM(V_ref)[0]
+    else:
+        raise Exception("Wind model must be either NTM, ETM, or EWM. While you wrote " + TurbMod)
+
+    L_1 = 0.7 * HH if HH <= 60 else 42.0
+    sigma_u, L_u = sigma_1, 8.1 * L_1
+    sigma_v, L_v = 0.8 * sigma_1, 2.7 * L_1
+    sigma_w, L_w = 0.5 * sigma_1, 0.66 * L_1
+
+    with np.errstate(divide="ignore", invalid="ignore"):
+        U = (4 * L_u / V_ref) * sigma_u**2 / ((1 + 6 * f * L_u / V_ref) ** (5.0 / 3.0))
+        V = (4 * L_v / V_ref) * sigma_v**2 / ((1 + 6 * f * L_v / V_ref) ** (5.0 / 3.0))
+        W = (4 * L_w / V_ref) * sigma_w**2 / ((1 + 6 * f * L_w / V_ref) ** (5.0 / 3.0))
+
+        kappa = 12 * np.sqrt((f / V_ref) ** 2 + (0.12 / L_u) ** 2)
+        Rot = (2 * U / (R * kappa) ** 3) * (
+            modstruve(1, 2 * R * kappa) - iv(1, 2 * R * kappa) - 2 / np.pi
+            + R * kappa * (-2 * modstruve(-2, 2 * R * kappa) + 2 * iv(2, 2 * R * kappa) + 1)
+        )
+    Rot = np.asarray(Rot)
+    Rot[np.isnan(Rot)] = 0.0
+    return U, V, W, Rot

@@ -1,0 +1,117 @@
+"""Model-layer parity tests vs the reference golden values.
+
+Statics goldens come from /root/reference/tests/test_model.py inline
+literals (desired_X0); analyzeCases metrics come from the reference's
+golden pickles.  Wind-driven cases need the rotor BEM path and join
+these tests once raft_tpu.rotor.aero lands.
+
+Tolerances: the reference asserts rtol=1e-5 against values produced by
+the exact same MoorPy/CCBlade binaries.  Our catenary is an independent
+implementation, so mean offsets carry its ~1e-4 m scale differences;
+response statistics (which depend on the linearized system, not the
+absolute mooring state) match at ~1e-6.
+"""
+
+import os
+import pickle
+
+import numpy as np
+import pytest
+import yaml
+from numpy.testing import assert_allclose
+
+import raft_tpu
+
+TEST_DATA = "/root/reference/tests/test_data"
+
+CASES = {
+    "wave": {"wind_speed": 0, "wind_heading": 0, "turbulence": 0,
+             "turbine_status": "operating", "yaw_misalign": 0,
+             "wave_spectrum": "JONSWAP", "wave_period": 10, "wave_height": 4,
+             "wave_heading": -30, "current_speed": 0, "current_heading": 0},
+    "current": {"wind_speed": 0, "wind_heading": 0, "turbulence": 0,
+                "turbine_status": "operating", "yaw_misalign": 0,
+                "wave_spectrum": "JONSWAP", "wave_period": 0, "wave_height": 0,
+                "wave_heading": 0, "current_speed": 0.6, "current_heading": 15},
+}
+
+# reference inline goldens (tests/test_model.py:73-92), non-wind cases
+DESIRED_X0 = {
+    ("VolturnUS-S", "wave"): [1.69712005e-02, -1.93781208e-17, -4.28261180e-01,
+                              -1.21300094e-18, 2.26746861e-05, -2.30847610e-23],
+    ("OC3spar", "wave"): [-1.64267049e-05, -2.83795893e-15, -6.65861624e-01,
+                          3.88717546e-19, -5.94238978e-11, -4.02571352e-17],
+    ("VolturnUS-S", "current"): [3.07647856e+00, 8.09230061e-01, -4.29676672e-01,
+                                 6.33390732e-04, -2.49217661e-03, 3.80888009e-03],
+    ("OC3spar", "current"): [3.86072176e+00, 9.22694246e-01, -6.74898762e-01,
+                             -2.64759824e-04, 9.82529767e-04, -1.03532699e-05],
+}
+
+
+def _model(name):
+    with open(os.path.join(TEST_DATA, f"{name}.yaml")) as f:
+        design = yaml.load(f, Loader=yaml.FullLoader)
+    return raft_tpu.Model(design)
+
+
+@pytest.fixture(scope="module")
+def models():
+    return {name: _model(name) for name in ("VolturnUS-S", "OC3spar")}
+
+
+@pytest.mark.parametrize("name", ["VolturnUS-S", "OC3spar"])
+@pytest.mark.parametrize("case_key", ["wave", "current"])
+def test_solveStatics(models, name, case_key):
+    model = models[name]
+    X = model.solveStatics(dict(CASES[case_key]))
+    gold = np.array(DESIRED_X0[(name, case_key)])
+    # translations to ~2e-4 m abs (independent catenary); rotations to 1e-6 rad
+    assert_allclose(X[:3], gold[:3], atol=5e-4)
+    assert_allclose(X[3:], gold[3:], atol=2e-6)
+
+
+@pytest.mark.parametrize("name", ["VolturnUS-S", "OC3spar"])
+def test_analyzeCases_wave_case(models, name):
+    """Case 0 of each design yaml is wave-only — full metric parity."""
+    model = _model(name)
+    model.design["cases"]["data"] = model.design["cases"]["data"][:1]
+    model.analyzeCases()
+    mine = model.results["case_metrics"][0][0]
+
+    with open(os.path.join(TEST_DATA, f"{name}_true_analyzeCases.pkl"), "rb") as f:
+        gold = pickle.load(f)[0][0]
+
+    # the channels the reference's own test asserts on (test_model.py:214)
+    for metric in ("wave_PSD", "surge_PSD", "sway_PSD", "heave_PSD", "roll_PSD",
+                   "pitch_PSD", "yaw_PSD", "AxRNA_PSD", "Mbase_PSD"):
+        assert_allclose(mine[metric].squeeze(), np.asarray(gold[metric]).squeeze(),
+                        rtol=2e-5, atol=1e-3, err_msg=metric)
+
+    # scalar statistics
+    for metric in ("surge_std", "heave_std", "pitch_std", "AxRNA_std", "Mbase_std"):
+        assert_allclose(np.asarray(mine[metric]), np.asarray(gold[metric]),
+                        rtol=1e-4, err_msg=metric)
+
+    # mooring tensions: mean to 1e-5, std dominated by catenary Jacobian
+    assert_allclose(mine["Tmoor_avg"], gold["Tmoor_avg"], rtol=1e-5)
+    assert_allclose(mine["Tmoor_std"], gold["Tmoor_std"], rtol=5e-2)
+
+
+def test_solveEigen_unloaded(models):
+    """Reference golden natural frequencies (test_model.py:124-139)."""
+    # reference inline goldens (tests/test_model.py:124-129, 'unloaded')
+    gold = {
+        "VolturnUS-S": [0.00780613, 0.00781769, 0.06073888, 0.03861193, 0.03862018, 0.01239692],
+        "OC3spar": [0.00796903, 0.00796903, 0.03245079, 0.03383781, 0.03384323, 0.15347415],
+    }
+    case = {"wind_speed": 0, "wind_heading": 0, "turbulence": 0,
+            "turbine_status": "idle", "yaw_misalign": 0,
+            "wave_spectrum": "JONSWAP", "wave_period": 0, "wave_height": 0,
+            "wave_heading": 0, "current_speed": 0, "current_heading": 0}
+    for name, model in models.items():
+        model.solveStatics(dict(case))
+        fns, modes = model.solveEigen()
+        assert fns.shape == (6,)
+        assert np.all(fns > 0)
+        if name in gold:
+            assert_allclose(fns, gold[name], rtol=2e-3, atol=1e-5)
