@@ -1,0 +1,108 @@
+"""Benchmark: RAO case solves per second (VolturnUS-S-class, 200 ω-bins).
+
+Prints ONE JSON line: {"metric", "value", "unit", "vs_baseline"}.
+
+The BASELINE north star is a 1000-design VolturnUS-S sweep (200 ω-bins
+× 12 sea states each) in < 60 s on a v4-8, i.e. 200 case-solves/sec
+across the pod (BASELINE.json; the reference publishes no numbers —
+`published: {}` — so the north-star-implied rate is the denominator).
+``vs_baseline`` is therefore measured cases/sec ÷ 200 on whatever
+hardware this runs on (the driver runs it on one real TPU chip).
+
+Uses the VolturnUS-S design from the reference test data when present
+(richer geometry); otherwise the built-in demo spar.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    # Make both the accelerator and the CPU backend available: the
+    # host-side model compilation is hundreds of tiny eager ops (slow to
+    # dispatch/compile on a TPU), so it runs pinned to CPU; only the
+    # fused case solver runs on the accelerator.
+    try:
+        platforms = jax.config.jax_platforms
+        if platforms and "cpu" not in platforms:
+            jax.config.update("jax_platforms", platforms + ",cpu")
+    except Exception:
+        pass
+
+    import jax.numpy as jnp
+
+    from raft_tpu.core.model import Model
+    from raft_tpu.parallel.case_solve import compile_case_solver
+    from raft_tpu.ops import waves
+
+    accel = jax.devices()[0]
+    try:
+        cpu = jax.devices("cpu")[0]
+    except RuntimeError:
+        cpu = accel
+
+    ref_yaml = "/root/reference/tests/test_data/VolturnUS-S.yaml"
+    if os.path.exists(ref_yaml):
+        import yaml
+
+        with open(ref_yaml) as f:
+            design = yaml.load(f, Loader=yaml.FullLoader)
+        design.setdefault("settings", {})
+        name = "VolturnUS-S"
+    else:
+        from raft_tpu.designs import demo_spar
+
+        design = demo_spar()
+        name = "demo-spar"
+    # 200 ω-bins per the BASELINE config
+    design["settings"]["min_freq"] = 0.005
+    design["settings"]["max_freq"] = 1.0
+
+    with jax.default_device(cpu):
+        model = Model(design)
+        fowt = model.fowtList[0]
+        fowt.setPosition(np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0]))
+        fowt.calcStatics()
+        fowt.calcHydroConstants()
+        solve = compile_case_solver(fowt, n_iter=15, include_aero=False,
+                                    device=accel)
+    batched = jax.jit(jax.vmap(solve))
+
+    # 12 sea states (Hs, Tp) per the BASELINE sweep config
+    n_case = 12
+    w = jnp.asarray(fowt.w)
+    Hs = jnp.linspace(2.0, 10.0, n_case)
+    Tp = jnp.linspace(6.0, 14.0, n_case)
+    S = jax.vmap(lambda h, t: waves.jonswap(w, h, t))(Hs, Tp)
+    zetas = jnp.sqrt(2.0 * S * fowt.dw)[:, None, :] + 0j
+    betas = jnp.zeros((n_case, 1))
+
+    # warmup/compile
+    Xi = batched(zetas, betas)
+    Xi.block_until_ready()
+
+    # steady-state timing: repeat the 12-case batch
+    reps = 20
+    t0 = time.perf_counter()
+    for _ in range(reps):
+        Xi = batched(zetas, betas)
+    Xi.block_until_ready()
+    dt = time.perf_counter() - t0
+    cases_per_sec = reps * n_case / dt
+
+    result = {
+        "metric": f"RAO cases/sec ({name}, 200 w-bins, strip theory, 15-iter drag linearization)",
+        "value": round(cases_per_sec, 2),
+        "unit": "cases/s",
+        "vs_baseline": round(cases_per_sec / 200.0, 3),
+    }
+    print(json.dumps(result))
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,92 @@
+"""Built-in demonstration designs (self-contained, no external files).
+
+Used by ``__graft_entry__.py`` and ``bench.py`` so the driver can
+compile-check and benchmark the framework without any external data.
+The demo platform is a generic ballasted spar FOWT in the spirit of the
+public OC3-Hywind configuration; values here are our own round-number
+choices, not a copy of any input file.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def demo_spar(depth=320.0, nw_freqs=(0.005, 1.0)) -> dict:
+    """A single-column ballasted spar with three catenary lines, a tower,
+    and an RNA point mass.  Strip-theory only (potModMaster 1)."""
+    min_freq, max_freq = nw_freqs
+    r_fair = 5.2
+    z_fair = -70.0
+    r_anchor = 850.0
+    lines = []
+    points = []
+    for i, th in enumerate((0.0, 120.0, 240.0)):
+        c, s = np.cos(np.radians(th)), np.sin(np.radians(th))
+        points.append({"name": f"anchor{i}", "type": "fixed",
+                       "location": [r_anchor * c, r_anchor * s, -depth]})
+        points.append({"name": f"fair{i}", "type": "vessel",
+                       "location": [r_fair * c, r_fair * s, z_fair]})
+        lines.append({"name": f"line{i}", "endA": f"anchor{i}", "endB": f"fair{i}",
+                      "type": "chain", "length": 900.0})
+
+    return {
+        "settings": {"min_freq": min_freq, "max_freq": max_freq,
+                     "XiStart": 0.1, "nIter": 15},
+        "site": {"water_depth": depth, "rho_water": 1025.0, "rho_air": 1.225,
+                 "mu_air": 1.81e-5, "shearExp": 0.12},
+        "cases": {
+            "keys": ["wind_speed", "wind_heading", "turbulence", "turbine_status",
+                     "yaw_misalign", "wave_spectrum", "wave_period", "wave_height",
+                     "wave_heading", "current_speed", "current_heading"],
+            "data": [[0, 0, 0, "operating", 0, "JONSWAP", 10, 6, 0, 0, 0]],
+        },
+        "turbine": {
+            "mRNA": 350000.0,
+            "IxRNA": 4.0e7,
+            "IrRNA": 2.5e7,
+            "xCG_RNA": 0.0,
+            "hHub": 90.0,
+            "overhang": -7.0,
+            "Rhub": 1.5,
+            "nBlades": 3,
+            "precone": 2.5,
+            "shaft_tilt": 5.0,
+            "aeroServoMod": 0,
+            "tower": {
+                "name": "tower", "type": 1,
+                "rA": [0.0, 0.0, 10.0], "rB": [0.0, 0.0, 87.6],
+                "shape": "circ", "gamma": 0.0,
+                "stations": [10.0, 87.6],
+                "d": [6.5, 3.9],
+                "t": [0.027, 0.019],
+                "Cd": 0.0, "Ca": 0.0, "CdEnd": 0.0, "CaEnd": 0.0,
+                "rho_shell": 8500.0,
+            },
+        },
+        "platform": {
+            "potModMaster": 1,
+            "dlsMax": 5.0,
+            "members": [
+                {
+                    "name": "column", "type": 2,
+                    "rA": [0.0, 0.0, -120.0], "rB": [0.0, 0.0, 10.0],
+                    "shape": "circ", "gamma": 0.0,
+                    "potMod": False,
+                    "stations": [-120.0, -12.0, -4.0, 10.0],
+                    "d": [9.4, 9.4, 6.5, 6.5],
+                    "t": [0.027, 0.027, 0.027, 0.027],
+                    "Cd": 0.6, "Ca": 1.0, "CdEnd": 0.6, "CaEnd": 1.0,
+                    "rho_shell": 7850.0,
+                    "l_fill": [52.0, 0.0, 0.0], "rho_fill": [1800.0, 0.0, 0.0],
+                },
+            ],
+        },
+        "mooring": {
+            "water_depth": depth,
+            "points": points,
+            "lines": lines,
+            "line_types": [{"name": "chain", "diameter": 0.09,
+                            "mass_density": 77.7, "stiffness": 3.84e8}],
+        },
+    }

@@ -13,6 +13,7 @@ from __future__ import annotations
 
 import jax
 import jax.numpy as jnp
+import numpy as np
 
 from ..config import GRAVITY, RHO_WATER
 
@@ -84,7 +85,7 @@ def wave_kinematics(zeta0, beta, w, k, depth, r, rho=RHO_WATER, g=GRAVITY):
     # resulting NaN even though the forward value is masked.  The safe
     # bound is dtype-dependent: sinh overflows f32 near 88 and f64 near
     # 709, so stay comfortably under log(finfo.max).
-    arg_max = 0.9 * float(jnp.log(jnp.finfo(w.dtype).max))
+    arg_max = 0.9 * float(np.log(np.finfo(np.dtype(w.dtype)).max))  # host-side constant
     kh_c = jnp.clip(kh, 1e-12, min(89.4, arg_max))
     kzh = jnp.clip(k * (z + depth), -arg_max, arg_max)
     sinh_r = jnp.where(deep, jnp.exp(kz), jnp.sinh(kzh) / jnp.sinh(kh_c))

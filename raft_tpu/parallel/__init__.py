@@ -1,0 +1,8 @@
+"""Batched / sharded execution layer (device-mesh parallelism).
+
+The reference is single-threaded NumPy; every latent parallel axis
+(frequency, node, heading, case, design — SURVEY.md §2.3) becomes an
+explicit vectorized or sharded axis here.
+"""
+
+from .case_solve import compile_case_solver, CaseBatch  # noqa: F401

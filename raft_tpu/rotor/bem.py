@@ -141,37 +141,54 @@ class _ElemGeom:
 
 def _solve_element(Vx, Vy, r_i, chord_i, theta_i, pitch, rotor, cl_i, cd_i, n_iter=96):
     """Bracketed bisection on R(phi) following CCBlade's strategy:
-    try (eps, pi/2]; if no sign change, (-pi/4, -eps); else (pi/2, pi-eps)."""
+    try (eps, pi/2]; if no sign change, (-pi/4, -eps); else (pi/2, pi-eps).
+
+    Wrapped in ``lax.custom_root`` so operating-point derivatives flow
+    through the solve by the implicit function theorem — bisection
+    brackets are constants, so naive AD would report dphi/dU = 0.
+    """
+
+    def resid_args(phi, args):
+        vx, vy, th, pi_ = args
+        geom = _ElemGeom(rotor, cl_i, cd_i)
+        return _phi_residual(phi, vx, vy, r_i, chord_i, th, pi_, geom)[0]
+
+    def bisect_solve(f, _x0):
+        eps = _EPS
+        r_lo1 = f(eps)
+        r_hi1 = f(jnp.pi / 2.0)
+        r_lo2 = f(-jnp.pi / 4.0)
+        r_hi2 = f(-eps)
+        use1 = r_lo1 * r_hi1 <= 0.0
+        use2 = (~use1) & (r_lo2 * r_hi2 < 0.0)
+
+        lo = jnp.where(use1, eps, jnp.where(use2, -jnp.pi / 4.0, jnp.pi / 2.0))
+        hi = jnp.where(use1, jnp.pi / 2.0, jnp.where(use2, -eps, jnp.pi - eps))
+        f_lo = jnp.where(use1, r_lo1, jnp.where(use2, r_lo2, r_hi1))
+
+        def body(_, state):
+            lo, hi, f_lo = state
+            mid = 0.5 * (lo + hi)
+            f_mid = f(mid)
+            take_lo = f_lo * f_mid <= 0.0
+            return (
+                jnp.where(take_lo, lo, mid),
+                jnp.where(take_lo, mid, hi),
+                jnp.where(take_lo, f_lo, f_mid),
+            )
+
+        lo, hi, _ = jax.lax.fori_loop(0, n_iter, body, (lo, hi, f_lo))
+        return 0.5 * (lo + hi)
+
+    args = (Vx, Vy, theta_i, pitch)
+    phi = jax.lax.custom_root(
+        lambda p: resid_args(p, args),
+        0.1,
+        bisect_solve,
+        lambda g, y: y / g(1.0),
+    )
+
     geom = _ElemGeom(rotor, cl_i, cd_i)
-
-    def resid(phi):
-        return _phi_residual(phi, Vx, Vy, r_i, chord_i, theta_i, pitch, geom)[0]
-
-    eps = _EPS
-    r_lo1 = resid(eps)
-    r_hi1 = resid(jnp.pi / 2.0)
-    r_lo2 = resid(-jnp.pi / 4.0)
-    r_hi2 = resid(-eps)
-    use1 = r_lo1 * r_hi1 <= 0.0
-    use2 = (~use1) & (r_lo2 * r_hi2 < 0.0)
-
-    lo = jnp.where(use1, eps, jnp.where(use2, -jnp.pi / 4.0, jnp.pi / 2.0))
-    hi = jnp.where(use1, jnp.pi / 2.0, jnp.where(use2, -eps, jnp.pi - eps))
-    f_lo = jnp.where(use1, r_lo1, jnp.where(use2, r_lo2, r_hi1))
-
-    def body(_, state):
-        lo, hi, f_lo = state
-        mid = 0.5 * (lo + hi)
-        f_mid = resid(mid)
-        take_lo = f_lo * f_mid <= 0.0
-        return (
-            jnp.where(take_lo, lo, mid),
-            jnp.where(take_lo, mid, hi),
-            jnp.where(take_lo, f_lo, f_mid),
-        )
-
-    lo, hi, _ = jax.lax.fori_loop(0, n_iter, body, (lo, hi, f_lo))
-    phi = 0.5 * (lo + hi)
     _, (a, ap, cl, cd, cn, ct, F) = _phi_residual(
         phi, Vx, Vy, r_i, chord_i, theta_i, pitch, geom
     )
@@ -215,22 +232,39 @@ def _distributed_loads(rotor: BEMRotor, Uinf, Omega, pitch, azimuth, tilt, yaw):
     Vrot_x = -Omega * y_az * sc
     Vrot_y = Omega * z_az
 
-    Vx = Vwind_x + Vrot_x
-    Vy = Vwind_y + Vrot_y
-    Vy = jnp.where(jnp.abs(Vy) < 1e-6, 1e-6, Vy)
-    Vx = jnp.where(jnp.abs(Vx) < 1e-6, 1e-6, Vx)
+    Vx_raw = Vwind_x + Vrot_x
+    Vy_raw = Vwind_y + Vrot_y
+    # parked / no-rotation elements (Omega ~ 0): the BEM residual is
+    # singular (lam -> 0 gives inf-inf in the bracketing), so those
+    # elements bypass the induction solve and use the static inflow
+    # triangle phi = atan2(Vx, Vy) with a = a' = 0, like CCBlade's
+    # special-case handling of Vy == 0
+    parked = jnp.abs(Vy_raw) < 1e-4 * jnp.maximum(jnp.abs(Vx_raw), 1e-3)
+    Vy = jnp.where(jnp.abs(Vy_raw) < 1e-6, 1e-6, Vy_raw)
+    Vx = jnp.where(jnp.abs(Vx_raw) < 1e-6, 1e-6, Vx_raw)
 
-    phi, a, ap, cn, ct_c = jax.vmap(
+    phi_s, a_s, ap_s, cn_s, ct_s = jax.vmap(
         lambda vx, vy, ri, ci, ti, cli, cdi: _solve_element(
             vx, vy, ri, ci, ti, pitch, rotor, cli, cdi
         )
     )(Vx, Vy, r, rotor.chord, rotor.theta, rotor.cl_tab, rotor.cd_tab)
 
+    # parked branch: direct polar lookup at the static inflow angle
+    phi_p = jnp.arctan2(Vx, Vy)
+    alpha_p = phi_p - (rotor.theta + pitch)
+    cl_p = jax.vmap(lambda tab, al: _interp_polar(tab, rotor.aoa_grid, al))(rotor.cl_tab, alpha_p)
+    cd_p = jax.vmap(lambda tab, al: _interp_polar(tab, rotor.aoa_grid, al))(rotor.cd_tab, alpha_p)
+    cn_p = cl_p * jnp.cos(phi_p) + cd_p * jnp.sin(phi_p)
+    ct_p = cl_p * jnp.sin(phi_p) - cd_p * jnp.cos(phi_p)
+
+    a = jnp.where(parked, 0.0, a_s)
+    ap = jnp.where(parked, 0.0, ap_s)
+    cn = jnp.where(parked, cn_p, cn_s)
+    ct_c = jnp.where(parked, ct_p, ct_s)
+
     W2 = (Vx * (1.0 - a)) ** 2 + (Vy * (1.0 + ap)) ** 2
     q = 0.5 * rotor.rho * W2 * rotor.chord
-    Np = cn * q
-    Tp = ct_c * q
-    return Np, Tp, cone, x_az, y_az, z_az
+    return cn * q, ct_c * q, cone, x_az, y_az, z_az
 
 
 def _integrate_hub_loads(rotor: BEMRotor, Np, Tp, cone, x_az, y_az, z_az, azimuth):
@@ -272,17 +306,13 @@ def _integrate_hub_loads(rotor: BEMRotor, Np, Tp, cone, x_az, y_az, z_az, azimut
     My = trapz(z_e * fx - x_e * fz)
     Mz = trapz(x_e * fy - y_e * fx)
 
-    # rotate azimuth frame -> hub frame (rotation about shaft x by -azimuth)
+    # rotate azimuth frame -> hub frame; mapping and signs calibrated
+    # against the reference's CCBlade golden pickles (blade azimuth from
+    # vertical-up, clockwise rotation viewed from upwind)
     sa, ca = jnp.sin(azimuth), jnp.cos(azimuth)
-
-    def rot(vy, vz):
-        return vy * sa + vz * ca, -vy * ca + vz * sa
-
-    # blade azimuth measured from vertical-up, rotor spins so that the
-    # y-z components map as below (calibrated against CCBlade goldens)
-    Fy_h, Fz_h = ca * Fy + sa * Fz, -sa * Fy + ca * Fz
-    My_h, Mz_h = ca * My + sa * Mz, -sa * My + ca * Mz
-    return jnp.array([Fx, Fy_h, Fz_h, -Mx, My_h, Mz_h])
+    Fy_h, Fz_h = ca * Fy - sa * Fz, sa * Fy + ca * Fz
+    My_h, Mz_h = ca * My - sa * Mz, sa * My + ca * Mz
+    return jnp.array([Fx, Fy_h, Fz_h, Mx, My_h, Mz_h])
 
 
 def evaluate(rotor: BEMRotor, Uinf, Omega_radps, pitch_rad, tilt=0.0, yaw=0.0):
@@ -304,7 +334,7 @@ def evaluate(rotor: BEMRotor, Uinf, Omega_radps, pitch_rad, tilt=0.0, yaw=0.0):
     F = rotor.n_blades * jnp.mean(loads, axis=0)
 
     T = F[0]
-    Q = -F[3]  # torque about shaft; sign so that driving torque is positive
+    Q = -F[3]  # aero torque positive-driving (shaft -x moment in these axes)
     P = Q * Omega_radps
 
     rho = rotor.rho
@@ -323,18 +353,28 @@ def evaluate(rotor: BEMRotor, Uinf, Omega_radps, pitch_rad, tilt=0.0, yaw=0.0):
     return out
 
 
+@jax.jit
+def _eval_and_jac(rotor: BEMRotor, x, tilt, yaw):
+    """Single jitted pass: loads dict + d[T,Q]/d[U, Omega, pitch].
+
+    ``has_aux`` reuses the primal trace, so the 96-iteration root solve
+    runs once (the reviewer-measured eager double-solve cost minutes
+    per call on host).
+    """
+
+    def f(xi):
+        out = evaluate(rotor, xi[0], xi[1], xi[2], tilt=tilt, yaw=yaw)
+        return jnp.array([out["T"], out["Q"]]), out
+
+    return jax.jacfwd(f, has_aux=True)(x)
+
+
 def evaluate_with_derivatives(rotor: BEMRotor, Uinf, Omega_radps, pitch_rad,
                               tilt=0.0, yaw=0.0):
     """Loads plus exact Jacobians dT/d(U, Omega, pitch) and dQ/d(...)
     via forward-mode AD (replaces CCBlade's Fortran derivatives)."""
-
-    def tq(x):
-        out = evaluate(rotor, x[0], x[1], x[2], tilt=tilt, yaw=yaw)
-        return jnp.array([out["T"], out["Q"]])
-
-    x0 = jnp.array([Uinf, Omega_radps, pitch_rad])
-    J = jax.jacfwd(tq)(x0)
-    out = evaluate(rotor, Uinf, Omega_radps, pitch_rad, tilt=tilt, yaw=yaw)
+    x0 = jnp.array([float(Uinf), float(Omega_radps), float(pitch_rad)])
+    J, out = _eval_and_jac(rotor, x0, jnp.asarray(float(tilt)), jnp.asarray(float(yaw)))
     derivs = {
         "dT_dU": J[0, 0], "dT_dOmega": J[0, 1], "dT_dpitch": J[0, 2],
         "dQ_dU": J[1, 0], "dQ_dOmega": J[1, 1], "dQ_dpitch": J[1, 2],

@@ -16,9 +16,13 @@ inputs.
 from __future__ import annotations
 
 import numpy as np
+import jax.numpy as jnp
 
 from ..ops import transforms
 from ..schema import get_from_dict
+
+rad2deg = 180.0 / np.pi
+rpm2radps = 2.0 * np.pi / 60.0
 
 
 def _rotation_matrix_np(r, p, y):
@@ -111,6 +115,207 @@ class Rotor:
             self.pitch_deg = np.zeros(0)
 
         self.I_drivetrain = float(get_from_dict(turbine, "I_drivetrain", shape=nrotors, default=0.0)[ir])
+
+        # fluid properties by medium (raft_rotor.py:325-332)
+        if self.r3[2] < 0:
+            self.rho = float(turbine.get("rho_water", 1025.0))
+            self.mu = float(turbine.get("mu_water", 1.0e-3))
+            self.shearExp = float(turbine.get("shearExp_water", 0.12))
+        else:
+            self.rho = float(turbine.get("rho_air", 1.225))
+            self.mu = float(turbine.get("mu_air", 1.81e-5))
+            self.shearExp = float(turbine.get("shearExp_air", 0.12))
+
+        # ----- compile the JAX BEM rotor (CCBlade-equivalent) -----
+        self.bem = None
+        if "blade" in turbine and "airfoils" in turbine:
+            from . import airfoils as _af
+            from . import bem as _bem
+
+            pol = _af.compile_polars(turbine, ir)
+            self._polars = pol
+            self.bem = _bem.BEMRotor(
+                r=jnp.asarray(pol["r"]),
+                chord=jnp.asarray(pol["chord"]),
+                theta=jnp.asarray(np.radians(pol["theta_deg"])),
+                precurve=jnp.asarray(pol["precurve"]),
+                presweep=jnp.asarray(pol["presweep"]),
+                Rhub=jnp.asarray(pol["Rhub"]),
+                Rtip=jnp.asarray(pol["Rtip"]),
+                precurve_tip=jnp.asarray(pol["precurve_tip"]),
+                presweep_tip=jnp.asarray(pol["presweep_tip"]),
+                hub_height=jnp.asarray(abs(float(self.r3[2])) if self.r3[2] != 0 else self.hHub),
+                precone=jnp.asarray(np.radians(self.precone)),
+                rho=jnp.asarray(self.rho),
+                mu=jnp.asarray(self.mu),
+                shear_exp=jnp.asarray(self.shearExp),
+                aoa_grid=jnp.asarray(pol["aoa_grid"]),
+                cl_tab=jnp.asarray(pol["cl_tab"]),
+                cd_tab=jnp.asarray(pol["cd_tab"]),
+                cpmin_tab=jnp.asarray(pol["cpmin_tab"]),
+                n_blades=self.nBlades,
+                n_sector=pol["nSector"],
+            )
+            if "pitch_control" in turbine:
+                self.setControlGains(turbine)
+
+    # ------------------------------------------------------------------
+    # controls (raft_rotor.py:770-784)
+    # ------------------------------------------------------------------
+
+    def setControlGains(self, turbine):
+        """ROSCO-convention control gains (flipped signs)."""
+        pc_angles = np.array(turbine["pitch_control"]["GS_Angles"]) * rad2deg
+        self.kp_0 = np.interp(self.pitch_deg, pc_angles, turbine["pitch_control"]["GS_Kp"],
+                              left=0, right=0)
+        self.ki_0 = np.interp(self.pitch_deg, pc_angles, turbine["pitch_control"]["GS_Ki"],
+                              left=0, right=0)
+        self.k_float = -turbine["pitch_control"]["Fl_Kp"]
+        self.kp_tau = -turbine["torque_control"]["VS_KP"]
+        self.ki_tau = -turbine["torque_control"]["VS_KI"]
+        self.Ng = turbine["gear_ratio"]
+
+    # ------------------------------------------------------------------
+    # steady BEM evaluation (raft_rotor.py:699-767)
+    # ------------------------------------------------------------------
+
+    def runCCBlade(self, U0, tilt=0, yaw_misalign=0):
+        """One steady BEM evaluation at the scheduled operating point.
+
+        Same name as the reference method for API parity; runs the JAX
+        BEM solver instead of the Fortran-backed CCBlade.
+        """
+        from . import bem as _bem
+
+        Uhub = U0 * self.speed_gain
+        Omega_rpm = float(np.interp(Uhub, self.Uhub, self.Omega_rpm))
+        pitch_deg = float(np.interp(Uhub, self.Uhub, self.pitch_deg))
+
+        out, derivs = _bem.evaluate_with_derivatives(
+            self.bem, Uhub, Omega_rpm * rpm2radps, np.radians(pitch_deg),
+            tilt=tilt, yaw=yaw_misalign,
+        )
+        loads = {k: np.atleast_1d(np.asarray(v)) for k, v in out.items()}
+
+        self.U_case = Uhub
+        self.Omega_case = Omega_rpm
+        self.aero_torque = float(loads["Q"][0])
+        self.aero_power = float(loads["P"][0])
+        self.aero_thrust = float(loads["T"][0])
+        self.pitch_case = pitch_deg
+
+        # derivative dict in CCBlade's unit conventions (per rpm / per deg)
+        J = {}
+        J["T", "Uhub"] = np.atleast_1d(float(derivs["dT_dU"]))
+        J["T", "Omega_rpm"] = np.atleast_1d(float(derivs["dT_dOmega"]) * rpm2radps)
+        J["T", "pitch_deg"] = np.atleast_1d(float(derivs["dT_dpitch"]) * np.pi / 180)
+        J["Q", "Uhub"] = np.atleast_1d(float(derivs["dQ_dU"]))
+        J["Q", "Omega_rpm"] = np.atleast_1d(float(derivs["dQ_dOmega"]) * rpm2radps)
+        J["Q", "pitch_deg"] = np.atleast_1d(float(derivs["dQ_dpitch"]) * np.pi / 180)
+        self.J = J
+        return loads, J
+
+    # ------------------------------------------------------------------
+    # aero-servo coefficients (raft_rotor.py:788-1005)
+    # ------------------------------------------------------------------
+
+    def calcAero(self, case, current=False, display=0):
+        """Aero-servo added mass/damping/excitation about the hub.
+
+        aeroServoMod 1: quasi-steady thrust-derivative damping only.
+        aeroServoMod 2: closed-loop PI pitch/torque control transfer
+        functions (H_QT formulation, raft_rotor.py:943-960).
+        """
+        from .wind import kaimal_rotor_spectra
+
+        self.a = np.zeros([6, 6, self.nw])
+        self.b = np.zeros([6, 6, self.nw])
+        self.f = np.zeros([6, self.nw], dtype=complex)
+        self.f0 = np.zeros(6)
+
+        if current:
+            speed = float(get_from_dict(case, "current_speed", shape=0, default=1.0))
+            heading = float(get_from_dict(case, "current_heading", shape=0, default=0.0))
+            turbulence = get_from_dict(case, "current_turbulence", shape=0, default=0.0, dtype=str)
+        else:
+            speed = float(get_from_dict(case, "wind_speed", shape=0, default=10))
+            heading = float(get_from_dict(case, "wind_heading", shape=0, default=0.0))
+            turbulence = get_from_dict(case, "turbulence", shape=0, default=0.0, dtype=str)
+
+        self.inflow_heading = np.radians(heading)
+        self.turbine_heading = np.radians(
+            float(get_from_dict(case, "turbine_heading", shape=0, default=0.0))
+        )
+        self.setYaw()
+
+        yaw_misalign = np.arctan2(self.q[1], self.q[0]) - self.inflow_heading
+        turbine_tilt = np.arctan2(self.q[2], np.hypot(self.q[0], self.q[1]))
+
+        loads, _ = self.runCCBlade(speed, tilt=turbine_tilt, yaw_misalign=yaw_misalign)
+        J = self.J
+
+        dT_dU = J["T", "Uhub"][0]
+        dT_dOm = J["T", "Omega_rpm"][0] / rpm2radps
+        dT_dPi = J["T", "pitch_deg"][0] * rad2deg
+        dQ_dU = J["Q", "Uhub"][0]
+        dQ_dOm = J["Q", "Omega_rpm"][0] / rpm2radps
+        dQ_dPi = J["Q", "pitch_deg"][0] * rad2deg
+
+        # steady hub loads rotated to global orientation (raft_rotor.py:840-847)
+        forces_axis = np.array([loads["T"][0], loads["Y"][0], loads["Z"][0]])
+        moments_axis = np.array([loads["My"][0], loads["Q"][0], loads["Mz"][0]])
+        self.f0[:3] = self.R_q @ forces_axis
+        self.f0[3:] = self.R_q @ moments_axis
+
+        # rotor-averaged turbulence spectrum -> wind amplitude spectrum
+        try:
+            turb = float(turbulence)
+        except (TypeError, ValueError):
+            turb = turbulence
+        _, _, _, S_rot = kaimal_rotor_spectra(self.w, speed, turb, self.r3[2], self.R_rot)
+        self.V_w = np.array(np.sqrt(S_rot), dtype=complex)
+
+        def rotate6_perfreq(mat_diag_00):
+            """Rotate a [nw] fore-aft-only coefficient into global frame."""
+            out = np.zeros([6, 6, self.nw])
+            R = np.asarray(self.R_q)
+            base = np.outer(R[:, 0], R[:, 0])  # R @ diag([v,0,0]) @ R.T
+            out[:3, :3, :] = base[:, :, None] * mat_diag_00[None, None, :]
+            return out
+
+        if self.aeroServoMod == 1:
+            b_inflow = np.broadcast_to(dT_dU, (self.nw,)).copy()
+            self.b = rotate6_perfreq(b_inflow)
+            f_inflow = dT_dU * self.V_w
+            self.f[:3, :] = np.asarray(self.R_q)[:, 0][:, None] * f_inflow[None, :]
+
+        elif self.aeroServoMod == 2:
+            self.kp_beta = -np.interp(speed, self.Uhub, self.kp_0)
+            self.ki_beta = -np.interp(speed, self.Uhub, self.ki_0)
+            kp_tau = self.kp_tau * (self.kp_beta == 0)
+            ki_tau = self.ki_tau * (self.ki_beta == 0)
+
+            w = self.w
+            D = (self.I_drivetrain * w**2
+                 + (dQ_dOm + self.kp_beta * dQ_dPi - self.Ng * kp_tau) * 1j * w
+                 + self.ki_beta * dQ_dPi - self.Ng * ki_tau)
+            C = 1j * w * (dQ_dU - self.k_float * dQ_dPi / self.r3[2]) / D
+            self.C = C
+
+            H_QT = ((dT_dOm + self.kp_beta * dT_dPi) * 1j * w + self.ki_beta * dT_dPi) / D
+            self.c_exc = dT_dU - H_QT * dQ_dU
+
+            f2 = (dT_dU - H_QT * dQ_dU) * self.V_w
+            b2 = np.real(dT_dU - self.k_float * dT_dPi - H_QT * (dQ_dU - self.k_float * dQ_dPi))
+            a2 = np.real((dT_dU - self.k_float * dT_dPi
+                          - H_QT * (dQ_dU - self.k_float * dQ_dPi)) / (1j * w))
+
+            self.a = rotate6_perfreq(a2)
+            self.b = rotate6_perfreq(b2)
+            R = np.asarray(self.R_q)
+            self.f[:3, :] = R[:, 0][:, None] * f2[None, :]
+
+        return self.f0, self.f, self.a, self.b
 
     # ------------------------------------------------------------------
     # pose
