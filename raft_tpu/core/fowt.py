@@ -307,8 +307,38 @@ class FOWT:
         self.B_BEM = np.zeros([6, 6, self.nw])
         self.B_struc = np.zeros([6, 6])
 
+        # preexisting WAMIT-style coefficient files (raft_fowt.py:222-228)
         self.potFirstOrder = int(get_from_dict(platform, "potFirstOrder", dtype=int, default=0))
+        self.X_BEM = np.zeros([1, 6, self.nw], dtype=complex)
+        self.BEM_headings = np.array([0.0])
+        if self.potFirstOrder == 1:
+            if "hydroPath" not in platform:
+                raise Exception("If potFirstOrder==1, then hydroPath must be specified in the platform input.")
+            self.hydroPath = platform["hydroPath"]
+            self.readHydro()
+
+        # ----- second-order hydro configuration (raft_fowt.py:230-257) -----
         self.potSecOrder = int(get_from_dict(platform, "potSecOrder", dtype=int, default=0))
+        if self.potSecOrder == 1:
+            if "min_freq2nd" not in platform or "max_freq2nd" not in platform:
+                raise Exception(
+                    "If potSecOrder==1, then both min_freq2nd and max_freq2nd must be "
+                    "specified in the platform input."
+                )
+            min_f2 = float(platform["min_freq2nd"])
+            max_f2 = float(platform["max_freq2nd"])
+            df2 = float(platform.get("df_freq2nd", min_f2))
+            self.w1_2nd = np.arange(min_f2, max_f2 + 0.5 * min_f2, df2) * 2 * np.pi
+            self.w2_2nd = self.w1_2nd.copy()
+            self.k1_2nd = np.asarray(waves.wave_number(jnp.asarray(self.w1_2nd), self.depth))
+            self.k2_2nd = self.k1_2nd.copy()
+        elif self.potSecOrder == 2:
+            if "hydroPath" not in platform:
+                raise Exception("If potSecOrder==2, then hydroPath must be specified in the platform input.")
+            self.qtfPath = platform["hydroPath"] + ".12d"
+            from ..hydro import second_order as so
+            so.read_qtf(self, self.qtfPath)
+        self.outFolderQTF = platform.get("outFolderQTF", None)
 
         # per-member runtime state (poses, wave kinematics, drag matrices)
         self._poses = [None] * len(self.memberList)
@@ -565,7 +595,15 @@ class FOWT:
                 )
             F_iner = F_iner + _member_inertial_excitation(cm.topo, pose, self._hydro[i], ud, pDyn, prp)
 
-        self.F_BEM = np.zeros((nH, 6, self.nw), dtype=complex)  # BEM path added with potential-flow module
+        # BEM-based excitation with heading interpolation (raft_fowt.py:1037-1093)
+        self.F_BEM = np.zeros((nH, 6, self.nw), dtype=complex)
+        if self.potMod or self.potModMaster in (2, 3) or self.potFirstOrder == 1:
+            if np.any(np.abs(self.X_BEM) > 0):
+                from ..hydro import wamit_io
+                ch = np.atleast_1d(np.asarray(heading, dtype=float))
+                for ih in range(nH):
+                    self.F_BEM[ih] = wamit_io.bem_excitation(self, ih, ch[ih])
+
         self.F_hydro_iner = np.asarray(F_iner)
         return self.F_hydro_iner
 
@@ -667,16 +705,31 @@ class FOWT:
         )
 
     def calcQTF_slenderBody(self, waveHeadInd=0, Xi0=None, verbose=False, iCase=None, iWT=None):
-        """Slender-body QTF (raft_fowt.py:1385-1648) — second-order module."""
-        raise NotImplementedError(
-            "second-order hydro (potSecOrder) not yet available in raft_tpu"
-        )
+        """Slender-body difference-frequency QTF (raft_fowt.py:1385-1648),
+        vectorized over the (w1, w2) plane — see raft_tpu.hydro.second_order."""
+        from ..hydro import second_order as so
+        return so.calc_qtf_slender_body(self, waveHeadInd, Xi0=Xi0, verbose=verbose,
+                                        iCase=iCase, iWT=iWT)
 
-    def calcHydroForce_2ndOrd(self, beta, S0, iCase=None, iWT=None):
-        """Second-order force realization (raft_fowt.py:1728-1818)."""
-        raise NotImplementedError(
-            "second-order hydro (potSecOrder) not yet available in raft_tpu"
-        )
+    def calcHydroForce_2ndOrd(self, beta, S0, iCase=None, iWT=None, interpMode="qtf"):
+        """Second-order force realization from the QTF (raft_fowt.py:1728-1818)."""
+        from ..hydro import second_order as so
+        return so.calc_hydro_force_2nd_ord(self, beta, S0, iCase=iCase, iWT=iWT,
+                                           interpMode=interpMode)
+
+    def readHydro(self):
+        """Read WAMIT .1/.3 coefficient files at self.hydroPath
+        (raft_fowt.py:719-768)."""
+        from ..hydro import wamit_io
+        return wamit_io.read_hydro(self)
+
+    def readQTF(self, flPath, ULEN=1):
+        from ..hydro import second_order as so
+        return so.read_qtf(self, flPath, ULEN=ULEN)
+
+    def writeQTF(self, qtfIn, outPath, w=None):
+        from ..hydro import second_order as so
+        return so.write_qtf(self, qtfIn, outPath)
 
     # ------------------------------------------------------------------
     # output statistics

@@ -428,7 +428,7 @@ class Model:
                     # internal QTF path: recompute with first-order motions
                     iiter = 0
                     Xi0 = np.asarray(waves.rao(Xi, fowt.zeta[0, :]))
-                    fowt.calcQTF_slenderBody(waveHeadInd=0, Xi0=Xi0, iCase=iCase, iWT=i)
+                    fowt.calcQTF_slenderBody(waveHeadInd=0, Xi0=Xi0, verbose=True, iCase=iCase, iWT=i)
                     fowt.Fhydro_2nd_mean[0, :], fowt.Fhydro_2nd[0, :, :] = fowt.calcHydroForce_2ndOrd(
                         fowt.beta[0], fowt.S[0, :], iCase=iCase, iWT=i
                     )
@@ -479,7 +479,7 @@ class Model:
                 if fowt.potSecOrder == 1:
                     if ih > 0:
                         Xi0 = np.asarray(waves.rao(self.Xi[ih, i1:i2, :], fowt.zeta[ih, :]))
-                        fowt.calcQTF_slenderBody(waveHeadInd=ih, Xi0=Xi0, iCase=iCase, iWT=i)
+                        fowt.calcQTF_slenderBody(waveHeadInd=ih, Xi0=Xi0, verbose=True, iCase=iCase, iWT=i)
                         fowt.Fhydro_2nd_mean[ih, :], fowt.Fhydro_2nd[ih, :, :] = fowt.calcHydroForce_2ndOrd(
                             fowt.beta[ih], fowt.S[ih, :]
                         )

@@ -1,0 +1,2 @@
+"""Hydrodynamics modules beyond first-order strip theory:
+second-order (QTF) loads, and potential-flow coefficient IO."""

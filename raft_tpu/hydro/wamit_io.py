@@ -1,0 +1,199 @@
+"""WAMIT-format hydrodynamic coefficient file IO (pyHAMS-equivalent).
+
+Readers for the nondimensional WAMIT `.1` (added mass / radiation
+damping) and `.3` (excitation) files that the reference obtains through
+``pyhams.pyhams.read_wamit1/read_wamit3`` (raft_fowt.py:655-664,
+719-768), plus the FOWT-level ``read_hydro`` that interpolates them
+onto the model frequency grid and rotates excitation into
+heading-relative axes.
+
+WAMIT period conventions: PER > 0 is a real period (ω = 2π/PER);
+PER = 0 is the infinite-frequency limit; PER < 0 is the zero-frequency
+limit (added mass only).
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+
+def read_wamit1(path, TFlag=True):
+    """Read a WAMIT .1 file.
+
+    Returns (addedMass [6,6,nfreq], damping [6,6,nfreq], w [nfreq]) with
+    the pyHAMS ordering the reference relies on: index 0 = zero
+    frequency, index 1 = infinite frequency, then ascending ω
+    (raft_fowt.py:727 expects exactly this).  Missing zero/infinite
+    entries are zero-filled so the interpolation stacking still works.
+    """
+    data = np.loadtxt(path)
+    pers = data[:, 0]
+    w_of = {}
+    for p in np.unique(pers):
+        if p == 0:
+            w_of[p] = np.inf
+        elif p < 0:
+            w_of[p] = 0.0
+        else:
+            w_of[p] = 2.0 * np.pi / p if TFlag else p
+
+    real_ws = sorted({v for v in w_of.values() if np.isfinite(v) and v > 0})
+    w = np.array([0.0, np.inf] + real_ws)
+    idx = {0.0: 0, np.inf: 1}
+    idx.update({wv: i + 2 for i, wv in enumerate(real_ws)})
+
+    A = np.zeros([6, 6, len(w)])
+    B = np.zeros([6, 6, len(w)])
+    for row in data:
+        k = idx[w_of[row[0]]]
+        i, j = int(row[1]) - 1, int(row[2]) - 1
+        A[i, j, k] = row[3]
+        if len(row) > 4:
+            B[i, j, k] = row[4]
+    return A, B, w
+
+
+def read_wamit3(path, TFlag=True):
+    """Read a WAMIT .3 excitation file.
+
+    Returns (Mod, Pha, Re, Im, w [nfreq], headings [deg]) with arrays
+    shaped [nheadings, 6, nfreq] like pyHAMS read_wamit3.
+    """
+    data = np.loadtxt(path)
+    ws = np.array(sorted({2.0 * np.pi / p if TFlag else p for p in np.unique(data[:, 0]) if p > 0}))
+    heads = np.array(sorted(set(data[:, 1])))
+    iw = {wv: i for i, wv in enumerate(ws)}
+    ih = {h: i for i, h in enumerate(heads)}
+
+    M = np.zeros([len(heads), 6, len(ws)])
+    P = np.zeros_like(M)
+    R = np.zeros_like(M)
+    I = np.zeros_like(M)
+    for row in data:
+        wv = 2.0 * np.pi / row[0] if TFlag else row[0]
+        k = iw[wv]
+        h = ih[row[1]]
+        d = int(row[2]) - 1
+        M[h, d, k] = row[3]
+        P[h, d, k] = row[4]
+        R[h, d, k] = row[5]
+        I[h, d, k] = row[6]
+    return M, P, R, I, ws, heads
+
+
+def _interp_axis2(w_src, arr, w_dst):
+    """Linear interpolation along the last axis (clamped ends), matching
+    the reference's interp1d(assume_sorted=False) usage."""
+    order = np.argsort(w_src)
+    ws = np.asarray(w_src)[order]
+    a = arr[..., order]
+    out = np.empty(arr.shape[:-1] + (len(w_dst),))
+    flat = a.reshape(-1, len(ws))
+    for i in range(flat.shape[0]):
+        out.reshape(-1, len(w_dst))[i] = np.interp(w_dst, ws, flat[i])
+    return out
+
+
+def read_hydro(fowt):
+    """FOWT.readHydro equivalent (raft_fowt.py:719-768): read .1/.3 at
+    fowt.hydroPath, interpolate to the model ω grid, rotate excitation
+    into heading-relative axes; fills A_BEM, B_BEM, X_BEM, BEM_headings."""
+    import os
+
+    addedMass, damping, w1 = read_wamit1(fowt.hydroPath + ".1", TFlag=True)
+    if os.path.exists(fowt.hydroPath + ".3"):
+        M, P, R, I, w3, heads = read_wamit3(fowt.hydroPath + ".3", TFlag=True)
+    else:
+        # tolerate a missing excitation file (e.g. the reference's
+        # OC4semi-WAMIT_Coefs example ships only the .1/.12d pair):
+        # radiation coefficients still load; excitation stays zero and
+        # strip theory provides the first-order forcing
+        print(f"Warning: {fowt.hydroPath}.3 not found; BEM excitation set to zero "
+              "(using strip-theory excitation only).")
+        heads = np.array([0.0])
+        w3 = np.array([w1[-1] if len(w1) > 2 else 1.0])
+        R = np.zeros([1, 6, 1])
+        I = np.zeros([1, 6, 1])
+
+    fowt.BEM_headings = np.array(heads) % 360
+
+    # stack a zero-frequency column for smooth low-frequency behavior.
+    # If the file carried no explicit zero-frequency (PER<0) rows the
+    # reader zero-filled slot 0 — anchoring on 0 would linearly collapse
+    # A toward zero below the file's lowest frequency, so hold the
+    # lowest-frequency value instead.
+    A0 = addedMass[:, :, 0:1]
+    if not np.any(A0):
+        ilow = 2 + int(np.argmin(w1[2:]))
+        A0 = addedMass[:, :, ilow:ilow + 1]
+        print(f"Note: {fowt.hydroPath}.1 has no zero-frequency entries; "
+              "anchoring low-frequency added mass at the lowest available frequency.")
+    addedMassInterp = _interp_axis2(np.hstack([w1[2:], 0.0]),
+                                    np.dstack([addedMass[:, :, 2:], A0]),
+                                    fowt.w)
+    dampingInterp = _interp_axis2(np.hstack([w1[2:], 0.0]),
+                                  np.dstack([damping[:, :, 2:], np.zeros([6, 6, 1])]),
+                                  fowt.w)
+    fExRealInterp = _interp_axis2(np.hstack([w3, 0.0]),
+                                  np.dstack([R, np.zeros([len(heads), 6, 1])]), fowt.w)
+    fExImagInterp = _interp_axis2(np.hstack([w3, 0.0]),
+                                  np.dstack([I, np.zeros([len(heads), 6, 1])]), fowt.w)
+
+    fowt.A_BEM = fowt.rho_water * addedMassInterp
+    fowt.B_BEM = fowt.rho_water * dampingInterp
+    X_temp = fowt.rho_water * fowt.g * (fExRealInterp + 1j * fExImagInterp)
+
+    fowt.X_BEM = np.zeros_like(X_temp)
+    for ih in range(len(heads)):
+        s, c = np.sin(np.radians(heads[ih])), np.cos(np.radians(heads[ih]))
+        fowt.X_BEM[ih, 0, :] = c * X_temp[ih, 0, :] + s * X_temp[ih, 1, :]
+        fowt.X_BEM[ih, 1, :] = -s * X_temp[ih, 0, :] + c * X_temp[ih, 1, :]
+        fowt.X_BEM[ih, 2, :] = X_temp[ih, 2, :]
+        fowt.X_BEM[ih, 3, :] = c * X_temp[ih, 3, :] + s * X_temp[ih, 4, :]
+        fowt.X_BEM[ih, 4, :] = -s * X_temp[ih, 3, :] + c * X_temp[ih, 4, :]
+        fowt.X_BEM[ih, 5, :] = X_temp[ih, 5, :]
+
+    for name, arr in (("added mass", fowt.A_BEM), ("damping", fowt.B_BEM),
+                      ("excitation", fowt.X_BEM)):
+        if np.isnan(arr).any():
+            raise Exception(f"NaN values detected in BEM coefficients for {name}.")
+
+
+def bem_excitation(fowt, ih, case_heading_deg):
+    """Heading-interpolated BEM excitation for one sea state
+    (raft_fowt.py:1037-1093).  Returns F_BEM[ih] [6, nw] complex."""
+    phase_offset = np.exp(-1j * fowt.k * (
+        fowt.x_ref * np.cos(np.deg2rad(case_heading_deg))
+        + fowt.y_ref * np.sin(np.deg2rad(case_heading_deg))
+    ))
+    beta = (np.degrees(fowt.beta[ih]) - fowt.heading_adjust) % 360
+    headings = fowt.BEM_headings
+    nhs = len(headings)
+
+    if beta <= headings[0]:
+        hlast = headings[-1] - 360
+        i1, i2 = nhs - 1, 0
+        f2 = (beta - hlast) / (headings[0] - hlast)
+    elif beta >= headings[nhs - 1]:
+        hfirst = headings[0] + 360
+        i1, i2 = nhs - 1, 0
+        f2 = (beta - headings[-1]) / (hfirst - headings[-1])
+    else:
+        for i in range(nhs - 1):
+            if headings[i + 1] > beta:
+                i1, i2 = i, i + 1
+                f2 = (beta - headings[i]) / (headings[i + 1] - headings[i])
+                break
+    f1 = 1.0 - f2
+
+    X_prime = fowt.X_BEM[i1, :, :] * f1 + fowt.X_BEM[i2, :, :] * f2
+
+    s, c = np.sin(fowt.beta[ih]), np.cos(fowt.beta[ih])
+    X = np.zeros([6, fowt.nw], dtype=complex)
+    X[0, :] = X_prime[0, :] * c - X_prime[1, :] * s
+    X[1, :] = X_prime[0, :] * s + X_prime[1, :] * c
+    X[2, :] = X_prime[2, :]
+    X[3, :] = X_prime[3, :] * c - X_prime[4, :] * s
+    X[4, :] = X_prime[3, :] * s + X_prime[4, :] * c
+    X[5, :] = X_prime[5, :]
+    return X * fowt.zeta[ih, :] * phase_offset

@@ -1,0 +1,150 @@
+"""Second-order wave kinematics kernels (vectorized).
+
+JAX re-designs of the reference's scalar per-(node, frequency) helpers
+(helpers.py:157-291): first-order velocity/acceleration/pressure
+gradients and the difference-frequency second-order potential.  All
+kernels broadcast over arbitrary leading node/frequency axes so the QTF
+assembly is pure tensor algebra over the (ω1, ω2) plane.
+
+Conventions follow the reference exactly, including its quirky
+double-deg2rad of the heading in grad_u1 (helpers.py:162-163 applies
+deg2rad to an already-radian beta for the khz terms while using raw
+beta in the phase) — kept verbatim for parity.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+_DEEP_KH = 10.0
+
+
+def _khz_ratios(k, z, depth, by="sinh"):
+    """cosh(k(z+h))/f(kh) and sinh(k(z+h))/f(kh) with the reference's
+    kh>=10 deep-water switch (helpers.py:169-175)."""
+    kh = k * depth
+    deep = kh >= _DEEP_KH
+    kh_c = jnp.clip(kh, 1e-12, 600.0)
+    kzh = jnp.clip(k * (z + depth), -600.0, 600.0)
+    denom = jnp.sinh(kh_c) if by == "sinh" else jnp.cosh(kh_c)
+    c = jnp.where(deep, jnp.exp(k * z), jnp.cosh(kzh) / denom)
+    s = jnp.where(deep, jnp.exp(k * z), jnp.sinh(kzh) / denom)
+    return c, s
+
+
+def grad_u1(w, k, beta, depth, r):
+    """Gradient of first-order wave velocity, [..., 3, 3].
+
+    ``w``/``k`` broadcast against the leading shape of ``r`` [..., 3].
+    Matches helpers.getWaveKin_grad_u1 including its deg2rad(beta)
+    direction cosines (beta arrives in radians there too).
+    """
+    w = jnp.asarray(w)
+    k = jnp.asarray(k)
+    x, y, z = r[..., 0], r[..., 1], r[..., 2]
+
+    cosB = jnp.cos(jnp.deg2rad(beta))  # parity: reference re-converts radians
+    sinB = jnp.sin(jnp.deg2rad(beta))
+
+    khz_xy, khz_z = _khz_ratios(k, z, depth, by="sinh")
+    active = (z <= 0) & (k > 0)
+    khz_xy = jnp.where(active, khz_xy, 0.0)
+    khz_z = jnp.where(active, khz_z, 0.0)
+
+    phase = jnp.exp(-1j * (k * (jnp.cos(beta) * x + jnp.sin(beta) * y)))
+
+    aux_x = w * cosB * phase
+    aux_y = w * sinB * phase
+    aux_z = 1j * w * phase
+
+    dudx = -1j * aux_x * khz_xy * k * cosB
+    dudy = -1j * aux_x * khz_xy * k * sinB
+    dudz = aux_x * k * khz_z
+    dvdy = -1j * aux_y * khz_xy * k * sinB
+    dwdz = aux_z * k * khz_xy
+
+    # symmetric/irrotational structure as in the reference (note it sets
+    # grad[2,1] = du/dy, helpers.py:192 — kept verbatim)
+    row0 = jnp.stack([dudx, dudy, dudz], axis=-1)
+    row1 = jnp.stack([dudy, dvdy, aux_y * k * khz_z], axis=-1)
+    row2 = jnp.stack([dudz, dudy, dwdz], axis=-1)
+    return jnp.stack([row0, row1, row2], axis=-2)
+
+
+def grad_pres1st(k, beta, depth, r, rho=1025.0, g=9.81):
+    """Gradient of first-order dynamic pressure, [..., 3]
+    (helpers.getWaveKin_grad_pres1st)."""
+    k = jnp.asarray(k)
+    x, y, z = r[..., 0], r[..., 1], r[..., 2]
+    cosB = jnp.cos(jnp.deg2rad(beta))
+    sinB = jnp.sin(jnp.deg2rad(beta))
+
+    khz_xy, khz_z = _khz_ratios(k, z, depth, by="cosh")
+    active = (z <= 0) & (k > 0)
+    khz_xy = jnp.where(active, khz_xy, 0.0)
+    khz_z = jnp.where(active, khz_z, 0.0)
+
+    phase = jnp.exp(-1j * (k * (cosB * x + sinB * y)))
+    gx = rho * g * khz_xy * phase * (-1j * k * cosB)
+    gy = rho * g * khz_xy * phase * (-1j * k * sinB)
+    gz = rho * g * khz_z * phase * k
+    return jnp.stack([gx, gy, gz], axis=-1)
+
+
+def pot2nd(w1, w2, k1, k2, beta, depth, r, g=9.81, rho=1025.0):
+    """Difference-frequency second-order potential: acceleration [..., 3]
+    and pressure [...] (helpers.getWaveKin_pot2ndOrd, unidirectional).
+
+    ``w1``/``w2``/``k1``/``k2`` broadcast against ``r`` [..., 3].  The
+    diagonal (w1 == w2) contributes nothing (the reference early-returns).
+    """
+    w1 = jnp.asarray(w1)
+    w2 = jnp.asarray(w2)
+    k1 = jnp.asarray(k1)
+    k2 = jnp.asarray(k2)
+    z = r[..., 2]
+
+    # parity quirk: the reference deg2rad's the already-radian heading
+    # here too (helpers.py:263-267)
+    cosB = jnp.cos(jnp.deg2rad(beta))
+    sinB = jnp.sin(jnp.deg2rad(beta))
+
+    kdx = k1 * cosB - k2 * cosB
+    kdy = k1 * sinB - k2 * sinB
+    norm_kd = jnp.sqrt(kdx**2 + kdy**2)
+    norm_safe = jnp.where(norm_kd > 0, norm_kd, 1.0)
+
+    same = jnp.abs(w1 - w2) < 1e-12
+    dw_safe = jnp.where(same, 1.0, (w1 - w2) ** 2)
+
+    th1 = jnp.tanh(jnp.clip(k1 * depth, 0.0, 600.0))
+    th2 = jnp.tanh(jnp.clip(k2 * depth, 0.0, 600.0))
+    thd = jnp.tanh(jnp.clip(norm_safe * depth, 0.0, 600.0))
+
+    denom = dw_safe / g - norm_kd * thd
+    denom = jnp.where(jnp.abs(denom) > 1e-30, denom, 1e-30)
+    gamma_12 = (-1j * g / (2 * w1)) * (
+        (k1**2) * (1 - th1**2) - 2 * k1 * k2 * (1 + th1 * th2)
+    ) / denom
+    gamma_21 = (-1j * g / (2 * w2)) * (
+        (k2**2) * (1 - th2**2) - 2 * k2 * k1 * (1 + th2 * th1)
+    ) / denom
+    aux = 0.5 * (gamma_21 + jnp.conj(gamma_12))
+
+    kzh = jnp.clip(norm_kd * (z + depth), -600.0, 600.0)
+    khc = jnp.clip(norm_kd * depth, 1e-12, 600.0)
+    khz_xy = jnp.cosh(kzh) / jnp.cosh(khc)
+    khz_z = jnp.sinh(kzh) / jnp.cosh(khc)
+
+    phase = jnp.exp(-1j * (kdx * r[..., 0] + kdy * r[..., 1]))
+    base = aux * khz_xy * phase
+
+    ax = base * (w1 - w2) * kdx
+    ay = base * (w1 - w2) * kdy
+    az = aux * khz_z * phase * 1j * (w1 - w2) * norm_kd
+    p = base * (-1j) * rho * (w1 - w2)
+
+    active = (z <= 0) & (k1 > 0) & (k2 > 0) & (~same)
+    acc = jnp.stack([ax, ay, az], axis=-1) * active[..., None]
+    p = p * active
+    return acc, p
