@@ -311,6 +311,8 @@ class FOWT:
         self.potFirstOrder = int(get_from_dict(platform, "potFirstOrder", dtype=int, default=0))
         self.X_BEM = np.zeros([1, 6, self.nw], dtype=complex)
         self.BEM_headings = np.array([0.0])
+        if "hydroPath" in platform:
+            self.hydroPath = platform["hydroPath"]
         if self.potFirstOrder == 1:
             if "hydroPath" not in platform:
                 raise Exception("If potFirstOrder==1, then hydroPath must be specified in the platform input.")
@@ -694,15 +696,48 @@ class FOWT:
         """First-order potential-flow coefficients (raft_fowt.py:568-717).
 
         Strip-theory-only configurations (potModMaster 1 / no potMod
-        members) leave the BEM arrays zero, matching the reference.  The
-        WAMIT-file path (potModMaster 3) and the native panel BEM solver
-        land with the potential-flow module.
+        members) leave the BEM arrays zero, matching the reference.
+        potMod members are meshed (hydro.mesh, member2pnl-equivalent) and
+        solved with the native panel BEM (hydro.potential_bem) — the
+        TPU-side replacement for the reference's external HAMS process.
+        The .pnl mesh is written to ``meshDir`` for interop/OpenFAST use.
         """
         if not self.potMod:
             return
-        raise NotImplementedError(
-            "potential-flow BEM path not yet available; use potModMaster=1 (strip theory)"
-        )
+        if self.potModMaster == 3:
+            # precomputed-coefficients mode: read WAMIT files, never solve
+            # (reference raft_fowt.calcBEM only solves for potModMaster 0/2)
+            if self.potFirstOrder != 1:  # otherwise already read in __init__
+                self.hydroPath = getattr(self, "hydroPath", None)
+                if self.hydroPath is None:
+                    raise Exception("potModMaster 3 requires hydroPath in the platform input.")
+                self.readHydro()
+            return
+
+        from ..hydro import mesh as mesh_mod
+        from ..hydro.potential_bem import PanelBEM
+
+        mesh = mesh_mod.mesh_fowt_members(self, dz=dz, da=da)
+        if meshDir:
+            mesh.write_pnl(meshDir)
+        bem = PanelBEM(mesh, rho=self.rho_water, g=self.g)
+        A, B, X = bem.solve(self.w, self.k, headings_deg=headings)
+        self.A_BEM = A
+        self.B_BEM = B
+        # the solver returns global-frame excitation; store heading-relative
+        # components like read_hydro does (raft_fowt.py:744-760) so the
+        # shared bem_excitation path can rotate them back per sea state
+        X_rel = np.zeros_like(X)
+        for ih, hd in enumerate(np.asarray(headings, dtype=float)):
+            s, c = np.sin(np.radians(hd)), np.cos(np.radians(hd))
+            X_rel[ih, 0, :] = c * X[ih, 0, :] + s * X[ih, 1, :]
+            X_rel[ih, 1, :] = -s * X[ih, 0, :] + c * X[ih, 1, :]
+            X_rel[ih, 2, :] = X[ih, 2, :]
+            X_rel[ih, 3, :] = c * X[ih, 3, :] + s * X[ih, 4, :]
+            X_rel[ih, 4, :] = -s * X[ih, 3, :] + c * X[ih, 4, :]
+            X_rel[ih, 5, :] = X[ih, 5, :]
+        self.X_BEM = X_rel
+        self.BEM_headings = np.asarray(headings, dtype=float) % 360
 
     def calcQTF_slenderBody(self, waveHeadInd=0, Xi0=None, verbose=False, iCase=None, iWT=None):
         """Slender-body difference-frequency QTF (raft_fowt.py:1385-1648),

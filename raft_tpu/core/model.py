@@ -529,14 +529,164 @@ class Model:
         return self.results
 
     # ------------------------------------------------------------------
+    # plotting / export (raft_model.py:1194-1306, 1333-1431)
+    # ------------------------------------------------------------------
+
+    def plotResponses(self):
+        """PSD plots of the response channels for each case
+        (raft_model.py:1194-1229)."""
+        import matplotlib.pyplot as plt
+
+        fig, ax = plt.subplots(6, 1, sharex=True, figsize=(6, 6))
+        for i in range(self.nFOWT):
+            nCases = len(self.results["case_metrics"])
+            for iCase in range(nCases):
+                m = self.results["case_metrics"][iCase][i]
+                ax[0].plot(self.w / TwoPi, TwoPi * m["surge_PSD"])
+                ax[1].plot(self.w / TwoPi, TwoPi * m["heave_PSD"])
+                ax[2].plot(self.w / TwoPi, TwoPi * m["pitch_PSD"])
+                ax[3].plot(self.w / TwoPi, TwoPi * np.asarray(m["AxRNA_PSD"])[:, 0])
+                ax[4].plot(self.w / TwoPi, TwoPi * np.asarray(m["Mbase_PSD"])[:, 0])
+                ax[5].plot(self.w / TwoPi, TwoPi * m["wave_PSD"],
+                           label=f"FOWT {i+1}; Case {iCase+1}")
+        for a, lab in zip(ax, ("surge (m^2/Hz)", "heave (m^2/Hz)", "pitch (deg^2/Hz)",
+                               "nac. acc.", "twr. bend", "wave elev.")):
+            a.set_ylabel(lab)
+        ax[-1].set_xlabel("frequency (Hz)")
+        ax[-1].legend()
+        fig.suptitle("raft-tpu power spectral densities")
+        fig.tight_layout()
+        return fig, ax
+
+    def saveResponses(self, outPath):
+        """Text export of response PSDs per case (raft_model.py:1231-1261)."""
+        chooseMetrics = ["wave_PSD", "surge_PSD", "heave_PSD", "pitch_PSD",
+                         "AxRNA_PSD", "Mbase_PSD"]
+        metricUnit = ["m^2/Hz", "m^2/Hz", "m^2/Hz", "deg^2/Hz",
+                      "(m/s^2)^2/Hz", "(Nm)^2/Hz"]
+        for i in range(self.nFOWT):
+            for iCase in range(len(self.results["case_metrics"])):
+                metrics = self.results["case_metrics"][iCase][i]
+                cols = []
+                for mname in chooseMetrics:
+                    val = np.asarray(metrics[mname])
+                    cols.append(TwoPi * (val if val.ndim == 1 else val[:, 0]))
+                with open(f"{outPath}_Case{iCase+1}_WT{i}.txt", "w") as f:
+                    f.write("Frequency(Hz) " + " ".join(
+                        f"{mname}({u})" for mname, u in zip(chooseMetrics, metricUnit)) + "\n")
+                    for iw in range(self.nw):
+                        row = [self.w[iw] / TwoPi] + [c[iw] for c in cols]
+                        f.write(" ".join(f"{v: .6e}" for v in row) + "\n")
+
+    def plot(self, ax=None, color="k", **kwargs):
+        """3-D geometry plot: members as axis lines with widths, mooring
+        lines as catenary curves (light version of raft_model.py:1333-1431)."""
+        import matplotlib.pyplot as plt
+
+        if ax is None:
+            fig = plt.figure(figsize=(8, 8))
+            ax = fig.add_subplot(projection="3d")
+        for fowt in self.fowtList:
+            for pose in fowt._poses:
+                r = np.asarray(pose.r)
+                ax.plot(r[:, 0], r[:, 1], r[:, 2], color=color)
+            if fowt.ms is not None:
+                pos = np.asarray(moorsys.point_positions(
+                    fowt.ms, fowt.ms.params, jnp.asarray(fowt.r6)))
+                for iA, iB in zip(fowt.ms.line_iA, fowt.ms.line_iB):
+                    ax.plot(*np.stack([pos[iA], pos[iB]]).T, color="b", lw=0.8)
+        if self.ms is not None:  # array-level shared mooring (farm)
+            pos = np.asarray(moorsys.point_positions(
+                self.ms, self.ms.params, jnp.asarray(self._fowt_positions())))
+            for iA, iB in zip(self.ms.line_iA, self.ms.line_iB):
+                ax.plot(*np.stack([pos[iA], pos[iB]]).T, color="g", lw=0.8)
+        ax.set_xlabel("x (m)")
+        ax.set_ylabel("y (m)")
+        ax.set_zlabel("z (m)")
+        return ax
+
+    # ------------------------------------------------------------------
     # ballast adjustment (raft_model.py:1434-1624)
     # ------------------------------------------------------------------
 
-    def adjustBallast(self, fowt, heave_tol=1.0):
-        raise NotImplementedError("ballast trim lands with the sweep/OMDAO layer")
+    def adjustBallast(self, fowt, heave_tol=1.0, display=0):
+        """Trim ballast fill levels to bring unloaded heave within tolerance.
+
+        The reference crawls l_fill in 1 cm steps (raft_model.py:1434-1567);
+        here a scalar bisection on a single fill-scale factor applied to
+        all ballasted sections reaches the same equilibrium condition
+        (sum Fz ≈ 0) without the step-size hyperparameters.
+        """
+        import dataclasses as _dc
+
+        def heave_imbalance(scale):
+            for i, base in self._ballast_base.items():
+                cm = fowt.memberList[i]
+                fowt.memberList[i] = _dc.replace(
+                    cm, geom=_dc.replace(cm.geom, l_fill_frac=jnp.asarray(base * scale))
+                )
+            fowt.setPosition(np.zeros(6))
+            fowt.calcStatics()
+            sumFz = -fowt.M_struc[0, 0] * fowt.g + fowt.V * fowt.rho_water * fowt.g \
+                + self.F_moor0[2]
+            return sumFz / (fowt.rho_water * fowt.g * fowt.AWP)
+
+        self._ballast_base = {}
+        for i, cm in enumerate(fowt.memberList):
+            lf = np.asarray(cm.geom.l_fill_frac)
+            if np.any(lf > 0):
+                self._ballast_base[i] = lf
+        if not self._ballast_base:
+            return
+
+        lo, hi = 0.0, 1.0 / max(np.max(b).item() for b in self._ballast_base.values())
+        h_lo = heave_imbalance(lo)
+        h_hi = heave_imbalance(hi)
+        if h_lo * h_hi > 0:  # can't bracket: keep closest end
+            best = lo if abs(h_lo) < abs(h_hi) else hi
+            heave_imbalance(best)
+            return
+        for _ in range(40):
+            mid = 0.5 * (lo + hi)
+            h_mid = heave_imbalance(mid)
+            if abs(h_mid) < heave_tol / 10:
+                break
+            if h_lo * h_mid <= 0:
+                hi = mid
+            else:
+                lo, h_lo = mid, h_mid
 
     def adjustBallastDensity(self, fowt):
-        raise NotImplementedError("ballast trim lands with the sweep/OMDAO layer")
+        """Adjust ballast density (uniformly scaled) for zero unloaded heave
+        (raft_model.py:1569-1624 equivalent, closed-form).
+
+        Density enters the mass linearly, so the required scale solves
+        m_ballast*s = m_ballast + dmass directly — no iteration needed.
+        """
+        import dataclasses as _dc
+
+        fowt.setPosition(np.zeros(6))
+        fowt.calcStatics()
+        dmass = (fowt.V * fowt.rho_water * fowt.g + self.F_moor0[2]) / fowt.g \
+            - fowt.M_struc[0, 0]
+        # total ballast volume; the density ADDITION distributes the new
+        # mass proportionally to volume, like the reference's
+        # delta_rho_fill = sumFz/g/ballast_volume (raft_model.py:1602)
+        m_b = np.asarray(fowt.m_ballast)
+        pb = np.asarray(fowt.pb)
+        V_ballast = float(np.sum(m_b / np.maximum(pb, 1e-9))) if len(pb) else 0.0
+        if V_ballast <= 0:
+            return
+        delta_rho = dmass / V_ballast
+        for i, cm in enumerate(fowt.memberList):
+            rf = np.asarray(cm.geom.rho_fill)
+            if np.any(rf > 0):
+                fowt.memberList[i] = _dc.replace(
+                    cm, geom=_dc.replace(
+                        cm.geom, rho_fill=jnp.asarray(np.where(rf > 0, rf + delta_rho, rf)))
+                )
+        fowt.setPosition(np.zeros(6))
+        fowt.calcStatics()
 
 
 def runRAFT(input_file, turbine_file="", plot=0, ballast=False):

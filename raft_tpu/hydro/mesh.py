@@ -1,0 +1,278 @@
+"""Axisymmetric member panel mesher + HAMS/WAMIT mesh writers.
+
+Rebuild of the reference's member2pnl module
+(/root/reference/raft/member2pnl.py:8-310): discretize each member's
+radius profile by ``dz_max``, revolve it with adaptive azimuthal
+refinement (panel count doubles when the ring circumference outgrows
+``da_max``), add end caps, rotate/translate by the member pose, clip
+panels to the free surface, and deduplicate nodes.
+
+Differences from the reference are implementation-level only: node
+deduplication is a dict lookup instead of an O(n^2) list scan, and the
+revolve step is vectorized; panel layout and counts follow the same
+rules so the emitted .pnl is equivalent.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def _radius_profile(stations, radii, dz_max, da_max):
+    """Discretized (r, z) radius profile along the member axis with end
+    caps on both ends (member2pnl.py:113-165)."""
+    r_rp = [radii[0]]
+    z_rp = [stations[0]]
+
+    for i_s in range(1, len(radii)):
+        dr_s = radii[i_s] - radii[i_s - 1]
+        dz_s = stations[i_s] - stations[i_s - 1]
+        if dr_s == 0:
+            cos_m, sin_m = 1.0, 0.0
+            dz_ps = dz_max
+        elif dz_s == 0:
+            cos_m, sin_m = 0.0, np.sign(dr_s)
+            dz_ps = 0.6 * da_max
+        else:
+            m = dr_s / dz_s
+            dz_ps = (np.arctan(abs(m)) * 2 / np.pi * 0.6 * da_max
+                     + np.arctan(abs(1 / m)) * 2 / np.pi * dz_max)
+            L = np.hypot(dr_s, dz_s)
+            cos_m, sin_m = dz_s / L, dr_s / L
+        n_z = int(np.ceil(np.hypot(dr_s, dz_s) / dz_ps))
+        d_l = np.hypot(dr_s, dz_s) / n_z
+        for i_z in range(1, n_z + 1):
+            r_rp.append(radii[i_s - 1] + sin_m * i_z * d_l)
+            z_rp.append(stations[i_s - 1] + cos_m * i_z * d_l)
+
+    # end caps: B at the end, A prepended
+    n_r = int(np.ceil(radii[-1] / (0.6 * da_max))) if radii[-1] > 0 else 0
+    if n_r:
+        dr = radii[-1] / n_r
+        for i_r in range(n_r):
+            r_rp.append(radii[-1] - (1 + i_r) * dr)
+            z_rp.append(stations[-1])
+    n_r = int(np.ceil(radii[0] / (0.6 * da_max))) if radii[0] > 0 else 0
+    if n_r:
+        dr = radii[0] / n_r
+        for i_r in range(n_r):
+            r_rp.insert(0, radii[0] - (1 + i_r) * dr)
+            z_rp.insert(0, stations[0])
+    return r_rp, z_rp
+
+
+def _revolve(r_rp, z_rp, da_max):
+    """Revolve the radius profile into quad panels with adaptive
+    azimuthal count (doubling/halving transitions)."""
+    quads = []  # each: (4,3) array in member-local coordinates
+    naz = 8
+    for i in range(len(z_rp) - 1):
+        r1, r2 = r_rp[i], r_rp[i + 1]
+        z1, z2 = z_rp[i], z_rp[i + 1]
+
+        while (r1 * 2 * np.pi / naz >= da_max / 2) and (r2 * 2 * np.pi / naz >= da_max / 2):
+            naz *= 2
+        while naz > 8 and (r1 * 2 * np.pi / naz < da_max / 2) and (r2 * 2 * np.pi / naz < da_max / 2):
+            naz //= 2
+
+        grow = (r1 * 2 * np.pi / naz < da_max / 2) and (r2 * 2 * np.pi / naz >= da_max / 2)
+        shrink = (r1 * 2 * np.pi / naz >= da_max / 2) and (r2 * 2 * np.pi / naz < da_max / 2)
+
+        if grow:
+            for ia in range(1, naz // 2 + 1):
+                th1 = (ia - 1) * 4 * np.pi / naz
+                th2 = (ia - 0.5) * 4 * np.pi / naz
+                th3 = ia * 4 * np.pi / naz
+                mid = 0.5 * (np.array([r1 * np.cos(th1), r1 * np.sin(th1)])
+                             + np.array([r1 * np.cos(th3), r1 * np.sin(th3)]))
+                quads.append(np.array([
+                    [mid[0], mid[1], z1],
+                    [r2 * np.cos(th2), r2 * np.sin(th2), z2],
+                    [r2 * np.cos(th1), r2 * np.sin(th1), z2],
+                    [r1 * np.cos(th1), r1 * np.sin(th1), z1]]))
+                quads.append(np.array([
+                    [r1 * np.cos(th3), r1 * np.sin(th3), z1],
+                    [r2 * np.cos(th3), r2 * np.sin(th3), z2],
+                    [r2 * np.cos(th2), r2 * np.sin(th2), z2],
+                    [mid[0], mid[1], z1]]))
+        elif shrink:
+            for ia in range(1, naz // 2 + 1):
+                th1 = (ia - 1) * 4 * np.pi / naz
+                th2 = (ia - 0.5) * 4 * np.pi / naz
+                th3 = ia * 4 * np.pi / naz
+                mid = 0.5 * (np.array([r2 * np.cos(th1), r2 * np.sin(th1)])
+                             + np.array([r2 * np.cos(th3), r2 * np.sin(th3)]))
+                quads.append(np.array([
+                    [r1 * np.cos(th2), r1 * np.sin(th2), z1],
+                    [mid[0], mid[1], z2],
+                    [r2 * np.cos(th1), r2 * np.sin(th1), z2],
+                    [r1 * np.cos(th1), r1 * np.sin(th1), z1]]))
+                quads.append(np.array([
+                    [r1 * np.cos(th3), r1 * np.sin(th3), z1],
+                    [r2 * np.cos(th3), r2 * np.sin(th3), z2],
+                    [mid[0], mid[1], z2],
+                    [r1 * np.cos(th2), r1 * np.sin(th2), z1]]))
+        else:
+            for ia in range(1, naz + 1):
+                th1 = (ia - 1) * 2 * np.pi / naz
+                th2 = ia * 2 * np.pi / naz
+                quads.append(np.array([
+                    [r1 * np.cos(th2), r1 * np.sin(th2), z1],
+                    [r2 * np.cos(th2), r2 * np.sin(th2), z2],
+                    [r2 * np.cos(th1), r2 * np.sin(th1), z2],
+                    [r1 * np.cos(th1), r1 * np.sin(th1), z1]]))
+    return quads
+
+
+class PanelMesh:
+    """Accumulates members into one deduplicated node/panel set."""
+
+    def __init__(self):
+        self.nodes: list[list[float]] = []
+        self.panels: list[list[int]] = []  # [id, nverts, v1..v4] (1-based)
+        self._node_index: dict[tuple, int] = {}
+
+    def _node_id(self, p):
+        key = (round(float(p[0]), 6), round(float(p[1]), 6), round(float(p[2]), 6))
+        idx = self._node_index.get(key)
+        if idx is None:
+            self.nodes.append([float(p[0]), float(p[1]), float(p[2])])
+            idx = len(self.nodes)
+            self._node_index[key] = idx
+        return idx
+
+    def add_panel(self, verts):
+        """Add one panel (4x3), clipping to z<=0 and deduping nodes;
+        collapses to a triangle if two clipped vertices coincide."""
+        verts = np.array(verts, dtype=float)
+        if (verts[:, 2] > 0).all():
+            return
+        verts[:, 2] = np.minimum(verts[:, 2], 0.0)
+
+        ids = []
+        for p in verts:
+            nid = self._node_id(p)
+            if nid not in ids:
+                ids.append(nid)
+        if len(ids) < 3:
+            return
+        self.panels.append([len(self.panels) + 1, len(ids)] + ids)
+
+    def add_member(self, stations, diameters, rA, rB, dz_max=0, da_max=0):
+        """Mesh one axisymmetric member (meshMember equivalent)."""
+        stations = np.asarray(stations, dtype=float)
+        radii = 0.5 * np.asarray(diameters, dtype=float)
+        rA = np.asarray(rA, dtype=float)
+        rB = np.asarray(rB, dtype=float)
+        if dz_max == 0:
+            dz_max = stations[-1] / 20
+        if da_max == 0:
+            da_max = np.max(radii) / 8
+
+        r_rp, z_rp = _radius_profile(stations, radii, dz_max, da_max)
+        quads = _revolve(r_rp, z_rp, da_max)
+
+        # member pose rotation (Z1Y2Z3, member2pnl.py:246-263)
+        rAB = rB - rA
+        beta = np.arctan2(rAB[1], rAB[0])
+        phi = np.arctan2(np.hypot(rAB[0], rAB[1]), rAB[2])
+        s1, c1 = np.sin(beta), np.cos(beta)
+        s2, c2 = np.sin(phi), np.cos(phi)
+        R = np.array([[c1 * c2, -s1, c1 * s2],
+                      [c2 * s1, c1, s1 * s2],
+                      [-s2, 0.0, c2]])
+
+        for quad in quads:
+            self.add_panel(quad @ R.T + rA[None, :])
+        return self
+
+    def areas_centroids_normals(self):
+        """Panel areas, centroids, and outward normals (for the BEM solver)."""
+        A, C, N = [], [], []
+        nodes = np.asarray(self.nodes)
+        for p in self.panels:
+            v = nodes[np.array(p[2:]) - 1]
+            if p[1] == 3:
+                a = 0.5 * np.linalg.norm(np.cross(v[1] - v[0], v[2] - v[0]))
+                c = v.mean(axis=0)
+                n = np.cross(v[1] - v[0], v[2] - v[0])
+            else:
+                d1 = v[2] - v[0]
+                d2 = v[3] - v[1]
+                n = 0.5 * np.cross(d1, d2)
+                a = np.linalg.norm(n)
+                c = v.mean(axis=0)
+            nn = np.linalg.norm(n)
+            N.append(n / nn if nn > 0 else np.array([0.0, 0.0, 1.0]))
+            A.append(a)
+            C.append(c)
+        return np.array(A), np.array(C), np.array(N)
+
+    # ------------------------------------------------------------------
+    # writers
+    # ------------------------------------------------------------------
+
+    def write_pnl(self, oDir=""):
+        """HAMS HullMesh.pnl writer (member2pnl.writeMesh)."""
+        if oDir and not os.path.isdir(oDir):
+            os.makedirs(oDir)
+        path = os.path.join(oDir, "HullMesh.pnl")
+        with open(path, "w") as f:
+            f.write("    --------------Hull Mesh File---------------\n\n")
+            f.write("    # Number of Panels, Nodes, X-Symmetry and Y-Symmetry\n")
+            f.write(f"         {len(self.panels)}         {len(self.nodes)}         0         0\n\n")
+            f.write("    #Start Definition of Node Coordinates     ! node_number   x   y   z\n")
+            for i, nd in enumerate(self.nodes):
+                f.write(f"{i+1:>5}{nd[0]:18.3f}{nd[1]:18.3f}{nd[2]:18.3f}\n")
+            f.write("   #End Definition of Node Coordinates\n\n")
+            f.write("   #Start Definition of Node Relations   ! panel_number  number_of_vertices"
+                    "   Vertex1_ID   Vertex2_ID   Vertex3_ID   (Vertex4_ID)\n")
+            for p in self.panels:
+                f.write("".join(f"{v:>8}" for v in p) + "\n")
+            f.write("   #End Definition of Node Relations\n\n")
+            f.write("    --------------End Hull Mesh File---------------\n")
+        return path
+
+    def write_gdf(self, path, ulen=1.0, grav=9.80665):
+        """WAMIT .gdf mesh writer (member2pnl.py:314-545 equivalent)."""
+        nodes = np.asarray(self.nodes)
+        with open(path, "w") as f:
+            f.write("WAMIT-style GDF mesh written by raft_tpu\n")
+            f.write(f"{ulen:10.4f} {grav:10.4f}\n")
+            f.write("0  0\n")
+            f.write(f"{len(self.panels)}\n")
+            for p in self.panels:
+                v = nodes[np.array(p[2:]) - 1]
+                if p[1] == 3:
+                    v = np.vstack([v, v[-1:]])  # GDF wants quads; repeat last
+                for row in v:
+                    f.write(f"{row[0]:14.5f}{row[1]:14.5f}{row[2]:14.5f}\n")
+        return path
+
+
+def mesh_fowt_members(fowt, dz=0, da=0):
+    """Mesh every potMod member of a FOWT into one PanelMesh
+    (the meshing half of calcBEM, raft_fowt.py:600-620)."""
+    mesh = PanelMesh()
+    for i, cm in enumerate(fowt.memberList):
+        if not cm.topo.pot_mod:
+            continue
+        geom = cm.geom
+        stations = np.asarray(geom.stations_frac) * float(np.asarray(mstruct_axis_length(geom)))
+        ds = np.asarray(geom.d)
+        if ds.ndim == 2:  # rectangular members: mean side as equivalent diameter
+            ds = ds.mean(axis=1)
+        pose = fowt._poses[i]
+        rA = np.asarray(pose.rA)
+        rB = np.asarray(pose.rB)
+        mesh.add_member(stations, ds, rA, rB,
+                        dz_max=dz if dz else 0, da_max=da if da else 0)
+    return mesh
+
+
+def mstruct_axis_length(geom):
+    from ..structure.member import axis_length
+
+    return axis_length(geom)

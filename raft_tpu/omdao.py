@@ -1,0 +1,212 @@
+"""OpenMDAO wrapper: RAFT_OMDAO-compatible component boundary.
+
+The BASELINE north star requires the WEIS/WISDEM-facing interface to
+stay unchanged: a ``RAFT_OMDAO(om.ExplicitComponent)`` whose compute()
+rebuilds the design dict from OM inputs, runs the model, and maps
+results back to the declared outputs (reference: raft/omdao_raft.py).
+
+OpenMDAO isn't available in every environment this framework targets
+(it is not installed here), so the module degrades gracefully: the
+design-dict assembly and result-mapping logic live in plain functions
+(`assemble_design`, `extract_outputs`) that are fully testable without
+OpenMDAO, and the thin OM component wraps them when openmdao imports.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .core.model import Model
+
+try:
+    import openmdao.api as om
+
+    HAVE_OM = True
+except ImportError:  # pragma: no cover - environment without OpenMDAO
+    om = None
+    HAVE_OM = False
+
+
+def assemble_design(inputs, discrete_inputs, modeling_opts, turbine_opts,
+                    mooring_opts, member_opts, analysis_opts):
+    """Rebuild a RAFT design dict from flat OM-style inputs
+    (mirrors omdao_raft.py compute()'s assembly, :480-696).
+
+    ``inputs`` is any mapping from the reference's input names to
+    arrays; only the subset present is used, so partial WEIS models
+    work.  Members use the per-member name prefixes
+    ('platform_member{i}_*') like the reference.
+    """
+    design = {
+        "settings": dict(modeling_opts.get("settings", {})),
+        "site": {
+            "water_depth": float(np.ravel(inputs["mooring_water_depth"])[0])
+            if "mooring_water_depth" in inputs else modeling_opts.get("water_depth", 200.0),
+            "rho_water": float(np.ravel(inputs.get("rho_water", [1025.0]))[0]),
+            "rho_air": float(np.ravel(inputs.get("rho_air", [1.225]))[0]),
+            "mu_air": float(np.ravel(inputs.get("mu_air", [1.81e-5]))[0]),
+            "shearExp": float(np.ravel(inputs.get("shear_exp", [0.12]))[0]),
+        },
+        "cases": modeling_opts.get("cases", {"keys": [], "data": []}),
+        "platform": {"members": [], "potModMaster": int(modeling_opts.get("potModMaster", 1))},
+    }
+
+    nmembers = member_opts.get("nmembers", 0)
+    for i in range(nmembers):
+        pre = f"platform_member{i+1}_"
+        mem = {
+            "name": f"member{i+1}",
+            "type": 2,
+            "rA": np.asarray(inputs[pre + "rA"]).tolist(),
+            "rB": np.asarray(inputs[pre + "rB"]).tolist(),
+            "shape": member_opts.get("shapes", ["circ"] * nmembers)[i],
+            "gamma": float(np.ravel(inputs.get(pre + "gamma", [0.0]))[0]),
+            "stations": np.asarray(inputs[pre + "stations"]).tolist(),
+            "d": np.asarray(inputs[pre + "d"]).tolist(),
+            "t": np.asarray(inputs[pre + "t"]).tolist(),
+            "Cd": float(np.ravel(inputs.get(pre + "Cd", [0.6]))[0]),
+            "Ca": float(np.ravel(inputs.get(pre + "Ca", [1.0]))[0]),
+            "CdEnd": float(np.ravel(inputs.get(pre + "CdEnd", [0.6]))[0]),
+            "CaEnd": float(np.ravel(inputs.get(pre + "CaEnd", [1.0]))[0]),
+            "rho_shell": float(np.ravel(inputs.get(pre + "rho_shell", [7850.0]))[0]),
+        }
+        for opt in ("l_fill", "rho_fill", "potMod", "heading", "cap_stations",
+                    "cap_t", "cap_d_in"):
+            key = pre + opt
+            if key in inputs:
+                v = np.asarray(inputs[key])
+                mem[opt] = v.tolist() if v.ndim else v.item()
+        design["platform"]["members"].append(mem)
+
+    # mooring section (points/lines/line_types from flat arrays)
+    if mooring_opts.get("nlines", 0) > 0:
+        n_lines = mooring_opts["nlines"]
+        design["mooring"] = {
+            "water_depth": design["site"]["water_depth"],
+            "points": [], "lines": [], "line_types": [],
+        }
+        npts = mooring_opts.get("npoints", 2 * n_lines)
+        for i in range(npts):
+            pre = f"mooring_point{i+1}_"
+            design["mooring"]["points"].append({
+                "name": str(discrete_inputs.get(pre + "name", f"point{i+1}")),
+                "type": str(discrete_inputs.get(pre + "type", "fixed")),
+                "location": np.asarray(inputs[pre + "location"]).tolist(),
+            })
+        for i in range(n_lines):
+            pre = f"mooring_line{i+1}_"
+            design["mooring"]["lines"].append({
+                "name": f"line{i+1}",
+                "endA": str(discrete_inputs.get(pre + "endA", "")),
+                "endB": str(discrete_inputs.get(pre + "endB", "")),
+                "type": str(discrete_inputs.get(pre + "type", "chain")),
+                "length": float(np.ravel(inputs[pre + "length"])[0]),
+            })
+        ntypes = mooring_opts.get("nline_types", 1)
+        for i in range(ntypes):
+            pre = f"mooring_line_type{i+1}_"
+            design["mooring"]["line_types"].append({
+                "name": str(discrete_inputs.get(pre + "name", "chain")),
+                "diameter": float(np.ravel(inputs[pre + "diameter"])[0]),
+                "mass_density": float(np.ravel(inputs[pre + "mass_density"])[0]),
+                "stiffness": float(np.ravel(inputs[pre + "stiffness"])[0]),
+            })
+
+    if turbine_opts:
+        design["turbine"] = turbine_opts
+    return design
+
+
+def extract_outputs(model, outputs):
+    """Map model results into the reference's output names
+    (omdao_raft.py:748-810)."""
+    results = model.results
+    fowt = model.fowtList[0]
+    props = results.get("properties", {})
+    outputs["properties_substructure mass"] = props.get("substructure mass", fowt.m_sub)
+    outputs["properties_total mass"] = props.get("total mass", fowt.M_struc[0, 0])
+    outputs["properties_buoyancy (pgV)"] = props.get(
+        "buoyancy (pgV)", fowt.rho_water * fowt.g * fowt.V)
+
+    if "eigen" in results:
+        fns = np.asarray(results["eigen"]["frequencies"]).real
+        outputs["rigid_body_periods"] = 1.0 / np.maximum(fns, 1e-9)
+
+    cm = results.get("case_metrics", {})
+    if cm:
+        max_surge, max_pitch, max_axrna = 0.0, 0.0, 0.0
+        for iCase in cm:
+            m = cm[iCase][0]
+            max_surge = max(max_surge, abs(m["surge_max"]), abs(m["surge_min"]))
+            max_pitch = max(max_pitch, abs(m["pitch_max"]), abs(m["pitch_min"]))
+            max_axrna = max(max_axrna, float(np.max(m["AxRNA_max"])))
+            for key in ("surge_avg", "surge_std", "pitch_avg", "pitch_std",
+                        "heave_avg", "heave_std", "yaw_avg", "yaw_std"):
+                outputs[f"stats_{key}_case{iCase}"] = m[key]
+        # WEIS aggregate constraints (omdao_raft.py:794-810)
+        outputs["Max_Offset"] = max_surge
+        outputs["Max_PtfmPitch"] = max_pitch
+        outputs["max_nac_accel"] = max_axrna
+    return outputs
+
+
+def run_raft_omdao(inputs, discrete_inputs, options):
+    """Headless compute(): assemble → analyze → extract
+    (the body of RAFT_OMDAO.compute, omdao_raft.py:698-810)."""
+    design = assemble_design(
+        inputs, discrete_inputs,
+        options.get("modeling_options", {}),
+        options.get("turbine_options", {}),
+        options.get("mooring_options", {}),
+        options.get("member_options", {}),
+        options.get("analysis_options", {}),
+    )
+    model = Model(design)
+    model.analyzeUnloaded()
+    if design["cases"]["data"]:
+        model.analyzeCases()
+    model.calcOutputs()
+    model.solveEigen()
+    outputs = {}
+    extract_outputs(model, outputs)
+    return model, outputs
+
+
+if HAVE_OM:
+
+    class RAFT_OMDAO(om.ExplicitComponent):
+        """OpenMDAO component wrapping the raft_tpu model
+        (interface-compatible with the reference RAFT_OMDAO)."""
+
+        def initialize(self):
+            self.options.declare("modeling_options")
+            self.options.declare("turbine_options")
+            self.options.declare("mooring_options")
+            self.options.declare("member_options")
+            self.options.declare("analysis_options")
+
+        def setup(self):
+            # declare the aggregate outputs WEIS consumes; detailed
+            # per-case stats are added dynamically in compute()
+            self.add_output("Max_Offset", val=0.0, units="m")
+            self.add_output("Max_PtfmPitch", val=0.0, units="deg")
+            self.add_output("max_nac_accel", val=0.0, units="m/s**2")
+            self.add_output("rigid_body_periods", val=np.zeros(6), units="s")
+
+        def compute(self, inputs, outputs, discrete_inputs=None, discrete_outputs=None):
+            _, out = run_raft_omdao(dict(inputs), dict(discrete_inputs or {}),
+                                    dict(self.options))
+            for k, v in out.items():
+                if k in outputs:
+                    outputs[k] = v
+
+    class RAFT_Group(om.Group):
+        def initialize(self):
+            self.options.declare("modeling_options")
+            self.options.declare("turbine_options")
+            self.options.declare("mooring_options")
+            self.options.declare("member_options")
+            self.options.declare("analysis_options")
+
+        def setup(self):
+            self.add_subsystem("raft", RAFT_OMDAO(**dict(self.options)), promotes=["*"])

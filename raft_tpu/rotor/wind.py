@@ -21,6 +21,7 @@ class IECWindExtreme:
     def __init__(self):
         self.Turbine_Class = "I"
         self.Turbulence_Class = "B"
+        self.Vert_Slope = 0.0  # vertical inflow slope [deg]
         self.z_hub = 90.0
         self.D = 126.0
         self.I_ref = 0.14
@@ -44,6 +45,91 @@ class IECWindExtreme:
     def EWM(self, V_hub):
         V_e50 = 1.4 * self.V_ref
         return 0.11 * V_hub, V_e50, 0.8 * V_e50, self.V_ref, 0.8 * self.V_ref
+
+    # ------------------------------------------------------------------
+    # transient events (pyIECWind.py:79-420): each returns (t, columns)
+    # with the OpenFAST .wnd column layout
+    # [t, V, V_dir, V_vert, shear_horz, shear_vert, shear_vert_lin, V_gust, upflow]
+    # ------------------------------------------------------------------
+
+    def _base_columns(self, t, V_hub_in, alpha=0.2):
+        V_hub = V_hub_in * np.cos(np.radians(self.Vert_Slope))
+        V_vert = V_hub_in * np.sin(np.radians(self.Vert_Slope))
+        z = np.zeros_like(t)
+        return V_hub, {
+            "V": z + V_hub, "V_dir": z.copy(), "V_vert": z + V_vert,
+            "shear_horz": z.copy(), "shear_vert": z + alpha,
+            "shear_vert_lin": z.copy(), "V_gust": z.copy(), "upflow": z.copy(),
+        }
+
+    def EOG(self, V_hub_in, dt=0.05):
+        """Extreme operating gust (IEC 6.3.2.2)."""
+        self.setup()
+        T = 10.5
+        t = np.linspace(0.0, T, int(T / dt) + 1)
+        V_hub, c = self._base_columns(t, V_hub_in)
+        sigma_1 = self.NTM(V_hub)
+        _, _, V_e1, _, _ = self.EWM(V_hub)
+        V_gust = min(1.35 * (V_e1 - V_hub),
+                     3.3 * (sigma_1 / (1 + 0.1 * (self.D / self.Sigma_1))))
+        c["V_gust"] = np.where(
+            t < T, -0.37 * V_gust * np.sin(3 * np.pi * t / T) * (1 - np.cos(2 * np.pi * t / T)), 0.0)
+        return t, c
+
+    def EDC(self, V_hub_in, sign=+1, dt=0.05):
+        """Extreme direction change (IEC 6.3.2.4)."""
+        self.setup()
+        T = 6.0
+        t = np.linspace(0.0, T, int(T / dt) + 1)
+        V_hub, c = self._base_columns(t, V_hub_in)
+        sigma_1 = self.NTM(V_hub)
+        Theta_e = np.degrees(4.0 * np.arctan(sigma_1 / (V_hub * (1 + 0.01 * (self.D / self.Sigma_1)))))
+        Theta_e = min(Theta_e, 180.0)
+        c["V_dir"] = sign * np.where(t < T, 0.5 * Theta_e * (1 - np.cos(np.pi * t / T)), Theta_e)
+        return t, c
+
+    def ECD(self, V_hub_in, sign=+1, dt=0.05):
+        """Extreme coherent gust with direction change (IEC 6.3.2.5)."""
+        self.setup()
+        T = 10.0
+        t = np.linspace(0.0, T, int(T / dt) + 1)
+        V_hub, c = self._base_columns(t, V_hub_in)
+        V_cg = 15.0
+        Theta_cg = 180.0 if V_hub < 4 else 720.0 / V_hub
+        ramp = np.where(t < T, 0.5 * (1 - np.cos(np.pi * t / T)), 1.0)
+        c["V"] = V_hub + V_cg * ramp
+        c["V_dir"] = sign * Theta_cg * ramp
+        return t, c
+
+    def EWS(self, V_hub_in, sign=+1, vertical=True, dt=0.05):
+        """Extreme wind shear (IEC 6.3.2.6)."""
+        self.setup()
+        T = 12.0
+        t = np.linspace(0.0, T, int(T / dt) + 1)
+        V_hub, c = self._base_columns(t, V_hub_in)
+        sigma_1 = self.NTM(V_hub)
+        Beta = 6.4
+        shear = sign * (2.5 + 0.2 * Beta * sigma_1 * (self.D / self.Sigma_1) ** 0.25) \
+            * (1 - np.cos(2 * np.pi * t / T)) / V_hub
+        if vertical:
+            c["shear_vert_lin"] = shear
+        else:
+            c["shear_horz"] = shear
+        return t, c
+
+    @staticmethod
+    def write_wnd(path, t, columns, heading="! IEC transient wind file (raft_tpu)"):
+        """OpenFAST uniform-wind .wnd writer (pyIECWind.write_wnd)."""
+        order = ["V", "V_dir", "V_vert", "shear_horz", "shear_vert",
+                 "shear_vert_lin", "V_gust", "upflow"]
+        with open(path, "w") as f:
+            f.write(heading + "\n")
+            f.write("! Time  Wind    Wind    Vert.   Horiz.  Vert.   LinV    Gust   Upflow\n")
+            f.write("!       Speed   Dir     Speed   Shear   Shear   Shear   Speed\n")
+            for i, ti in enumerate(t):
+                row = [ti] + [columns[k][i] for k in order]
+                f.write("\t".join(f"{v:.5f}" for v in row) + "\n")
+        return path
 
 
 def kaimal_rotor_spectra(w, speed, turbulence, hub_height, R):

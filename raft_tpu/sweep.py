@@ -1,0 +1,110 @@
+"""Design-sweep execution layer (parametersweep-equivalent, batched).
+
+The reference sweeps designs with serial nested for-loops re-running
+the full model per point (raft/parametersweep.py:56-100) — its prime
+TPU-sharding target (SURVEY.md §2.3).  Here a sweep runs as:
+
+1.  host loop compiling each design variant (geometry changes, same
+    topology → identical trace shapes, so the jitted case solver is
+    compiled ONCE and reused across all variants);
+2.  per design, the sea-state batch solves as one vmapped, mesh-sharded
+    device call (raft_tpu.parallel.CaseBatch);
+3.  response statistics reduce on device.
+
+``sweep`` mirrors the reference's mutate-design-dict pattern: you give
+a base design, a list of (path, values) axes, and get the full factorial
+grid of metrics.
+"""
+
+from __future__ import annotations
+
+import copy
+import itertools
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from .core.model import Model
+from .parallel.case_solve import compile_case_solver
+from .ops import waves
+
+
+def set_in_design(design, path, value):
+    """Set a nested design-dict entry; path like
+    'platform.members.0.d' or a callable(design, value)."""
+    if callable(path):
+        path(design, value)
+        return
+    keys = path.split(".")
+    node = design
+    for k in keys[:-1]:
+        node = node[int(k)] if k.lstrip("-").isdigit() else node[k]
+    last = keys[-1]
+    if last.lstrip("-").isdigit():
+        node[int(last)] = value
+    else:
+        node[last] = value
+
+
+def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0):
+    """Run a factorial design sweep.
+
+    Parameters
+    ----------
+    base_design : dict
+        RAFT design dict (strip-theory configuration).
+    axes : list of (path_or_callable, values)
+        Design-variable axes; full factorial product is evaluated.
+    sea_states : list of (Hs, Tp) or (Hs, Tp, heading_deg)
+        Wave cases solved (batched) for every design variant.
+
+    Returns
+    -------
+    dict with 'grid' (list of value tuples) and 'metrics': arrays
+    [n_designs, n_cases, 6] of motion std-devs, plus 'Xi' amplitudes.
+    """
+    combos = list(itertools.product(*[v for _, v in axes]))
+    n_designs = len(combos)
+    stds = []
+    grid = []
+
+    batched = None
+    for ic, combo in enumerate(combos):
+        design = copy.deepcopy(base_design)
+        for (path, _), val in zip(axes, combo):
+            set_in_design(design, path, val)
+        grid.append(combo)
+
+        model = Model(design)
+        fowt = model.fowtList[0]
+        fowt.setPosition(np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0]))
+        fowt.calcStatics()
+        fowt.calcHydroConstants()
+
+        solve = compile_case_solver(fowt, n_iter=n_iter, include_aero=False,
+                                    device=device)
+        # geometry enters the solver as closed-over constants, so each
+        # design variant traces its own executable (same shapes, so XLA
+        # compilation is fast after the first); passing geometry as traced
+        # arguments to share one executable is the planned refinement
+        batched = jax.jit(jax.vmap(solve))
+
+        w = jnp.asarray(fowt.w)
+        zetas, betas = [], []
+        for ss in sea_states:
+            Hs, Tp = ss[0], ss[1]
+            beta = np.radians(ss[2]) if len(ss) > 2 else 0.0
+            S = waves.jonswap(w, Hs, Tp)
+            zetas.append(jnp.sqrt(2.0 * S * fowt.dw) + 0j)
+            betas.append(jnp.array([beta]))
+        zetas = jnp.stack(zetas)[:, None, :]
+        betas = jnp.stack(betas)
+
+        Xi = batched(zetas, betas)  # [ncase, 1, 6, nw]
+        std = jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, 0]) ** 2, axis=-1))  # [ncase, 6]
+        stds.append(np.asarray(std))
+        if display:
+            print(f"design {ic+1}/{n_designs}: {combo}")
+
+    return {"grid": grid, "motion_std": np.stack(stds)}

@@ -1,0 +1,115 @@
+"""Aux subsystem tests: IEC transient winds, sweep driver, OMDAO-style
+headless compute, ballast trim, response export."""
+
+import numpy as np
+import pytest
+
+from raft_tpu.rotor.wind import IECWindExtreme
+from raft_tpu.designs import demo_spar
+
+
+def test_iec_sigma_models():
+    iec = IECWindExtreme()
+    iec.Turbine_Class = "I"
+    iec.Turbulence_Class = "B"
+    iec.setup()
+    assert iec.V_ref == 50.0 and iec.I_ref == 0.14
+    # NTM at 10 m/s: 0.14*(7.5+5.6)
+    assert np.isclose(iec.NTM(10.0), 0.14 * (0.75 * 10 + 5.6))
+    sig, V_e50, V_e1, _, _ = iec.EWM(10.0)
+    assert np.isclose(V_e50, 70.0) and np.isclose(V_e1, 56.0)
+
+
+@pytest.mark.parametrize("event", ["EOG", "EDC", "ECD", "EWS"])
+def test_iec_transients(event, tmp_path):
+    iec = IECWindExtreme()
+    iec.setup()
+    t, cols = getattr(iec, event)(12.0)
+    assert t[0] == 0.0 and len(t) > 100
+    for k in ("V", "V_dir", "V_gust", "shear_vert"):
+        assert len(cols[k]) == len(t)
+        assert np.all(np.isfinite(cols[k]))
+    if event == "EOG":
+        assert cols["V_gust"].min() < -0.1  # gust dips
+    if event == "EDC":
+        assert abs(cols["V_dir"][-1]) > 5  # ends at full direction change
+    path = iec.write_wnd(str(tmp_path / "x.wnd"), t, cols)
+    assert len(open(path).readlines()) == len(t) + 3
+
+
+def test_sweep_driver():
+    from raft_tpu.sweep import sweep
+
+    design = demo_spar(nw_freqs=(0.05, 0.4))
+    out = sweep(
+        design,
+        axes=[("platform.members.0.d", [[9.4, 9.4, 6.5, 6.5], [10.0, 10.0, 6.5, 6.5]])],
+        sea_states=[(4.0, 8.0), (6.0, 10.0)],
+        n_iter=8,
+    )
+    assert len(out["grid"]) == 2
+    assert out["motion_std"].shape == (2, 2, 6)
+    assert np.all(np.isfinite(out["motion_std"]))
+    # bigger column -> different (generally larger) response somewhere
+    assert not np.allclose(out["motion_std"][0], out["motion_std"][1])
+
+
+def test_omdao_headless_compute():
+    """assemble_design -> Model -> extract_outputs without OpenMDAO."""
+    from raft_tpu.omdao import assemble_design, extract_outputs
+    from raft_tpu.core.model import Model
+
+    base = demo_spar(nw_freqs=(0.05, 0.4))
+    mem = base["platform"]["members"][0]
+    inputs = {
+        "mooring_water_depth": [320.0],
+        "platform_member1_rA": mem["rA"],
+        "platform_member1_rB": mem["rB"],
+        "platform_member1_stations": mem["stations"],
+        "platform_member1_d": mem["d"],
+        "platform_member1_t": mem["t"],
+        "platform_member1_l_fill": mem["l_fill"],
+        "platform_member1_rho_fill": mem["rho_fill"],
+    }
+    design = assemble_design(
+        inputs, {}, modeling_opts={"settings": base["settings"], "potModMaster": 1,
+                                   "cases": base["cases"]},
+        turbine_opts={}, mooring_opts={"nlines": 0},
+        member_opts={"nmembers": 1, "shapes": ["circ"]}, analysis_opts={},
+    )
+    design["mooring"] = base["mooring"]  # use the demo mooring directly
+    design["turbine"] = base["turbine"]
+    model = Model(design)
+    model.analyzeUnloaded()
+    model.analyzeCases()
+    model.calcOutputs()
+    model.solveEigen()
+    outputs = {}
+    extract_outputs(model, outputs)
+    assert outputs["Max_Offset"] > 0
+    assert outputs["Max_PtfmPitch"] > 0
+    assert len(outputs["rigid_body_periods"]) == 6
+    assert np.all(outputs["rigid_body_periods"] > 0)
+
+
+def test_ballast_density_trim():
+    from raft_tpu.core.model import Model
+
+    design = demo_spar(nw_freqs=(0.05, 0.4))
+    model = Model(design)
+    model.analyzeUnloaded(ballast=2)  # density trim
+    # unloaded heave should be near zero after trimming
+    assert abs(model.results["properties"]["offset_unloaded"][2]) < 0.2
+
+
+def test_save_responses(tmp_path):
+    from raft_tpu.core.model import Model
+
+    design = demo_spar(nw_freqs=(0.05, 0.4))
+    model = Model(design)
+    model.analyzeCases()
+    model.saveResponses(str(tmp_path / "resp"))
+    files = list(tmp_path.glob("resp_Case1_WT0.txt"))
+    assert len(files) == 1
+    lines = open(files[0]).readlines()
+    assert len(lines) == model.nw + 1

