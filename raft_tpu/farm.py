@@ -34,19 +34,26 @@ def power_thrust_curve(model, uhubs, nfowt=0, nrotor=0, heading=0.0):
 
     cp, ct, pitch, power, thrust = [], [], [], [], []
     for uhub in np.asarray(uhubs, dtype=float):
+        operating = 3.0 <= uhub <= 25.0
         case = {"wind_speed": float(uhub), "wind_heading": heading, "turbulence": 0.1,
-                "turbine_status": "operating" if 3 <= uhub <= 25 else "parked",
+                "turbine_status": "operating" if operating else "parked",
                 "yaw_misalign": 0, "wave_spectrum": "still", "wave_period": 0,
                 "wave_height": 0, "wave_heading": 0,
                 "current_speed": 0, "current_heading": 0}
         model.solveStatics(case)
-        turbine_tilt = np.arctan2(rot.q[2], rot.q[0])
-        loads, _ = rot.runCCBlade(uhub, tilt=turbine_tilt)
-        cp.append(float(loads["CP"][0]))
-        ct.append(float(loads["CT"][0]))
         pitch.append(np.degrees(fowt.Xi0[4]))
-        power.append(rot.aero_power)
-        thrust.append(rot.aero_thrust)
+        if operating:
+            turbine_tilt = np.arctan2(rot.q[2], rot.q[0])
+            loads, _ = rot.runCCBlade(uhub, tilt=turbine_tilt)
+            cp.append(float(loads["CP"][0]))
+            ct.append(float(loads["CT"][0]))
+            power.append(rot.aero_power)
+            thrust.append(rot.aero_thrust)
+        else:  # outside the operating envelope the turbine produces nothing
+            cp.append(0.0)
+            ct.append(0.0)
+            power.append(0.0)
+            thrust.append(0.0)
     return {"U": np.asarray(uhubs), "CP": np.array(cp), "CT": np.array(ct),
             "pitch_deg": np.array(pitch), "P": np.array(power), "T": np.array(thrust)}
 

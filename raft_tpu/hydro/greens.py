@@ -25,6 +25,8 @@ Wehausen & Laitone / John representation.
 
 from __future__ import annotations
 
+import os
+
 import numpy as np
 import jax.numpy as jnp
 
@@ -40,40 +42,60 @@ def _pv_integral(A, V, n_gauss=200):
 
     Singularity subtraction on [0, 2]:
         ∫0^2 [f(t) - f(1)]/(t-1) dt  (regular; PV of f(1)/(t-1) over
-        the symmetric interval vanishes), plus ∫2^T f(t)/(t-1) dt with
-        T chosen by the e^{Vt} decay (capped for V ~ 0 where the
-        integrand decays like t^{-3/2} through the Bessel function).
+        the symmetric interval vanishes), plus an oscillation-aware
+        composite-Gauss tail ∫2^T f(t)/(t-1) dt: panels no longer than
+        a quarter J0 period so large-A oscillations are resolved
+        instead of aliased (the earlier fixed-node rule corrupted the
+        table for A >~ 10 near the free surface).
     """
     from numpy.polynomial.legendre import leggauss
     from scipy.special import j0
 
-    A = np.asarray(A)[..., None]
-    V = np.asarray(V)[..., None]
+    A = np.asarray(A, dtype=float)
+    V = np.asarray(V, dtype=float)
+    A, V = np.broadcast_arrays(A, V)
+    Ae = A[..., None]
+    Ve = V[..., None]
 
     x, wq = leggauss(n_gauss)
 
     # regularized part on [0, 2]
     t1 = 0.5 * (x + 1.0) * 2.0
-    w1 = wq * 1.0
-    f1 = np.exp(V * t1) * j0(A * t1)
-    f_at_1 = np.exp(V) * j0(A)
+    f1 = np.exp(Ve * t1) * j0(Ae * t1)
+    f_at_1 = np.exp(Ve) * j0(Ae)
     with np.errstate(divide="ignore", invalid="ignore"):
         g1 = np.where(np.abs(t1 - 1.0) > 1e-12, (f1 - f_at_1) / (t1 - 1.0), 0.0)
-    # limit value at t=1: f'(1) = e^V (V J0(A) - A J1(A))
-    part1 = np.sum(g1 * w1, axis=-1)
+    part1 = np.sum(g1 * wq, axis=-1)
 
-    # tail [2, T]: T from decay of e^{Vt}; cap for small |V|
-    T = np.clip(2.0 + 40.0 / np.maximum(-V[..., 0], 0.15), 4.0, 400.0)
-    t2 = 2.0 + 0.5 * (x + 1.0)[None, ...] * (T[..., None] - 2.0)
-    w2 = wq[None, ...] * 0.5 * (T[..., None] - 2.0)
-    f2 = np.exp(V * t2) * j0(A * t2) / (t2 - 1.0)
+    # oscillation-aware tail: shared panel grid per call, panel length
+    # <= quarter period of the fastest oscillation present
+    A_max = float(np.max(A))
+    V_min = float(np.min(-np.maximum(-V, 1e-6)))  # most-negative V
+    T = 2.0 + min(max(10.0, 40.0 / max(-V_min, 0.15)), max(10.0, 600.0 / max(A_max, 1.0)))
+    T = min(T, 400.0)
+    panel_len = min(1.0, np.pi / (2.0 * max(A_max, 1e-6) + 1.0))
+    n_panels = int(np.ceil((T - 2.0) / panel_len))
+    edges = np.linspace(2.0, T, n_panels + 1)
+    xg, wg = leggauss(8)
+    mids = 0.5 * (edges[1:] + edges[:-1])
+    half = 0.5 * (edges[1:] - edges[:-1])
+    t2 = (mids[:, None] + half[:, None] * xg[None, :]).ravel()  # [n_panels*8]
+    w2 = (half[:, None] * wg[None, :]).ravel()
+    f2 = np.exp(Ve * t2) * j0(Ae * t2) / (t2 - 1.0)
     part2 = np.sum(f2 * w2, axis=-1)
 
     return part1 + part2
 
 
 class GreenTable:
-    """Host-precomputed PV-integral tables with device-side lookup."""
+    """Host-precomputed PV-integral tables with device-side lookup.
+
+    Built row-by-row (per A value) so the oscillation-aware tail rule
+    sizes its panels to each row's A; cached on disk because the build
+    costs ~a minute.
+    """
+
+    _CACHE = os.path.expanduser("~/.cache/raft_tpu/greens_table_v2.npz")
 
     def __init__(self, n_gauss=200):
         # grids: A quadratic clustering near 0, V log-like clustering near 0
@@ -82,10 +104,16 @@ class GreenTable:
         v_lin = np.linspace(0.0, 1.0, _NV)
         self.V_grid = _V_MIN * v_lin**2  # 0 .. V_MIN (descending values)
 
-        Ag, Vg = np.meshgrid(self.A_grid, self.V_grid, indexing="ij")
-        # clamp V slightly below 0 to keep the tail integrable
-        Vg_c = np.minimum(Vg, -1e-6)
-        self.I0 = _pv_integral(Ag, Vg_c, n_gauss=n_gauss)  # [NA, NV]
+        if os.path.exists(self._CACHE):
+            dat = np.load(self._CACHE)
+            if (dat["A_grid"].shape == self.A_grid.shape
+                    and np.allclose(dat["A_grid"], self.A_grid)
+                    and np.allclose(dat["V_grid"], self.V_grid)):
+                self.I0 = dat["I0"]
+            else:
+                self.I0 = self._build(n_gauss)
+        else:
+            self.I0 = self._build(n_gauss)
 
         # derivative tables via central differences of the (smooth) table
         self.dI_dA = np.gradient(self.I0, axis=0) / np.gradient(self.A_grid)[:, None]
@@ -96,6 +124,18 @@ class GreenTable:
         self._jdV = jnp.asarray(self.dI_dV)
         self._jAg = jnp.asarray(self.A_grid)
         self._jVg = jnp.asarray(self.V_grid)
+
+    def _build(self, n_gauss):
+        Vg = np.minimum(self.V_grid, -1e-6)  # keep the tail integrable
+        I0 = np.empty((_NA, _NV))
+        for i, a in enumerate(self.A_grid):
+            I0[i, :] = _pv_integral(np.full(_NV, a), Vg, n_gauss=n_gauss)
+        try:
+            os.makedirs(os.path.dirname(self._CACHE), exist_ok=True)
+            np.savez_compressed(self._CACHE, A_grid=self.A_grid, V_grid=self.V_grid, I0=I0)
+        except OSError:
+            pass
+        return I0
 
     def _lookup(self, table, A, V):
         # invert the quadratic/squared grid mappings analytically

@@ -153,7 +153,7 @@ class PanelBEM:
         return S_w, D_w
 
     def solve(self, w, k, headings_deg=(0.0,)):
-        """Full first-order solution: (A [nw,6,6], B [nw,6,6],
+        """Full first-order solution: (A [6,6,nw], B [6,6,nw],
         X [nheads,6,nw] complex excitation per unit amplitude).
 
         Conventions chosen to match WAMIT-style outputs the rest of the

@@ -186,16 +186,64 @@ if HAVE_OM:
             self.options.declare("analysis_options")
 
         def setup(self):
-            # declare the aggregate outputs WEIS consumes; detailed
-            # per-case stats are added dynamically in compute()
+            mem_opts = self.options["member_options"] or {}
+            moor_opts = self.options["mooring_options"] or {}
+            nmem = int(mem_opts.get("nmembers", 0))
+            nst = mem_opts.get("nstations", [10] * nmem)
+
+            self.add_input("mooring_water_depth", val=200.0, units="m")
+            self.add_input("rho_water", val=1025.0, units="kg/m**3")
+            self.add_input("rho_air", val=1.225, units="kg/m**3")
+
+            for i in range(nmem):
+                pre = f"platform_member{i+1}_"
+                n = int(nst[i]) if i < len(nst) else 10
+                self.add_input(pre + "rA", val=np.zeros(3), units="m")
+                self.add_input(pre + "rB", val=np.zeros(3), units="m")
+                self.add_input(pre + "gamma", val=0.0, units="deg")
+                self.add_input(pre + "stations", val=np.zeros(n))
+                self.add_input(pre + "d", val=np.zeros(n), units="m")
+                self.add_input(pre + "t", val=np.zeros(n), units="m")
+                self.add_input(pre + "Cd", val=0.6)
+                self.add_input(pre + "Ca", val=1.0)
+                self.add_input(pre + "CdEnd", val=0.6)
+                self.add_input(pre + "CaEnd", val=1.0)
+                self.add_input(pre + "rho_shell", val=7850.0, units="kg/m**3")
+                self.add_input(pre + "l_fill", val=np.zeros(max(n - 1, 1)), units="m")
+                self.add_input(pre + "rho_fill", val=np.zeros(max(n - 1, 1)), units="kg/m**3")
+
+            nlines = int(moor_opts.get("nlines", 0))
+            npts = int(moor_opts.get("npoints", 2 * nlines))
+            ntypes = int(moor_opts.get("nline_types", 1)) if nlines else 0
+            for i in range(npts):
+                self.add_input(f"mooring_point{i+1}_location", val=np.zeros(3), units="m")
+                self.add_discrete_input(f"mooring_point{i+1}_name", val=f"point{i+1}")
+                self.add_discrete_input(f"mooring_point{i+1}_type", val="fixed")
+            for i in range(nlines):
+                self.add_input(f"mooring_line{i+1}_length", val=100.0, units="m")
+                self.add_discrete_input(f"mooring_line{i+1}_endA", val="")
+                self.add_discrete_input(f"mooring_line{i+1}_endB", val="")
+                self.add_discrete_input(f"mooring_line{i+1}_type", val="chain")
+            for i in range(ntypes):
+                pre = f"mooring_line_type{i+1}_"
+                self.add_input(pre + "diameter", val=0.1, units="m")
+                self.add_input(pre + "mass_density", val=100.0, units="kg/m")
+                self.add_input(pre + "stiffness", val=1e8)
+                self.add_discrete_input(pre + "name", val="chain")
+
+            # aggregate outputs WEIS consumes
             self.add_output("Max_Offset", val=0.0, units="m")
             self.add_output("Max_PtfmPitch", val=0.0, units="deg")
             self.add_output("max_nac_accel", val=0.0, units="m/s**2")
             self.add_output("rigid_body_periods", val=np.zeros(6), units="s")
 
         def compute(self, inputs, outputs, discrete_inputs=None, discrete_outputs=None):
-            _, out = run_raft_omdao(dict(inputs), dict(discrete_inputs or {}),
-                                    dict(self.options))
+            opts = {k: self.options[k] for k in
+                    ("modeling_options", "turbine_options", "mooring_options",
+                     "member_options", "analysis_options")}
+            ins = {k: np.asarray(v) for k, v in dict(inputs).items()}
+            dins = dict(discrete_inputs) if discrete_inputs is not None else {}
+            _, out = run_raft_omdao(ins, dins, opts)
             for k, v in out.items():
                 if k in outputs:
                     outputs[k] = v
@@ -209,4 +257,7 @@ if HAVE_OM:
             self.options.declare("analysis_options")
 
         def setup(self):
-            self.add_subsystem("raft", RAFT_OMDAO(**dict(self.options)), promotes=["*"])
+            keys = ("modeling_options", "turbine_options", "mooring_options",
+                    "member_options", "analysis_options")
+            self.add_subsystem("raft", RAFT_OMDAO(**{k: self.options[k] for k in keys}),
+                               promotes=["*"])

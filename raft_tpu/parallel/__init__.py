@@ -5,4 +5,9 @@ The reference is single-threaded NumPy; every latent parallel axis
 explicit vectorized or sharded axis here.
 """
 
-from .case_solve import compile_case_solver, CaseBatch  # noqa: F401
+from .case_solve import (  # noqa: F401
+    compile_case_solver,
+    design_params,
+    make_parametric_solver,
+    CaseBatch,
+)

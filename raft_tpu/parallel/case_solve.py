@@ -83,29 +83,20 @@ def flatten_members(fowt):
     }
 
 
-def compile_case_solver(fowt, n_iter=15, include_aero=True, device=None):
-    """Build the pure case-solve function for one (already positioned)
-    FOWT.  ``calcStatics`` and ``calcHydroConstants`` must have run so
-    poses and hydro coefficient sets exist.
+def design_params(fowt, include_aero=True, device=None):
+    """Design-dependent arrays for the parametric solver, as a pytree.
 
-    The returned function treats the FOWT geometry, mass, mooring
-    stiffness, and (optionally) the current case's aero matrices as
-    constants; waves (zeta, beta) are the traced inputs.  Pass
-    ``device`` to place the closed-over constants explicitly (e.g. the
-    TPU chip while the host-side model was built on the CPU backend).
+    This is the traced-argument representation of one design variant:
+    flat node tensors plus the frequency-independent system matrices.
+    Stack a batch of these (same topology/discretization -> same shapes)
+    and `vmap` the parametric solver over the leading axis to sweep
+    designs in ONE compiled executable (the M2 sweep milestone).
     """
 
     def put(x):
         x = jnp.asarray(x)
         return jax.device_put(x, device) if device is not None else x
 
-    w = put(fowt.w)
-    k = put(fowt.k)
-    nw = fowt.nw
-    depth = fowt.depth
-    rho = fowt.rho_water
-    g = fowt.g
-    prp = put(fowt.r6[:3])
     nodes = {k2: (put(v) if not isinstance(v, bool) else v)
              for k2, v in flatten_members(fowt).items()}
 
@@ -117,25 +108,57 @@ def compile_case_solver(fowt, n_iter=15, include_aero=True, device=None):
     if include_aero:
         M_np = M_np + np.moveaxis(np.sum(fowt.A_aero, axis=3), 2, 0)
         B_np = B_np + np.moveaxis(np.sum(fowt.B_aero, axis=3), 2, 0)
-    M_const = put(M_np)
-    B_const = put(B_np)
-    C_const = put(np.asarray(fowt.getStiffness()))
 
+    mcf = nodes.pop("mcf")
+    params = {
+        "nodes": nodes,
+        "M": put(M_np),
+        "B": put(B_np),
+        "C": put(np.asarray(fowt.getStiffness())),
+        "prp": put(fowt.r6[:3]),
+        "w": put(fowt.w),
+        "k": put(fowt.k),
+    }
+    return params, {"mcf": mcf, "nw": fowt.nw, "depth": fowt.depth,
+                    "rho": fowt.rho_water, "g": fowt.g}
+
+
+def make_parametric_solver(static, n_iter=15):
+    """Pure function solve(params, zeta, beta) -> Xi [nH,6,nw].
+
+    ``static`` is the second return of :func:`design_params` (python
+    scalars baked into the trace); ``params`` carries every
+    design-dependent array, so one jit of this function serves an
+    entire design sweep via vmap over stacked params.
+    """
+    nw = static["nw"]
+    depth = static["depth"]
+    rho = static["rho"]
+    g = static["g"]
+    mcf = static["mcf"]
     XiStart = 0.1
-
-    r_nodes = nodes["r"]  # [N,3]
-    offs = r_nodes - prp
-    wet = (r_nodes[:, 2] < 0)
     drag_coef = np.sqrt(8.0 / np.pi) * 0.5 * rho
-    q_n, p1_n, p2_n = nodes["q"], nodes["p1"], nodes["p2"]
-    qq = jnp.einsum("ni,nj->nij", q_n, q_n)
-    p1p1 = jnp.einsum("ni,nj->nij", p1_n, p1_n)
-    p2p2 = jnp.einsum("ni,nj->nij", p2_n, p2_n)
 
     from ..ops import waves as waves_ops
     from ..ops import transforms
 
-    def solve(zeta, beta):
+    def solve(params, zeta, beta):
+        nodes = params["nodes"]
+        w = params["w"]
+        k = params["k"]
+        prp = params["prp"]
+        M_const = params["M"]
+        B_const = params["B"]
+        C_const = params["C"]
+
+        r_nodes = nodes["r"]  # [N,3]
+        offs = r_nodes - prp
+        wet = (r_nodes[:, 2] < 0)
+        q_n, p1_n, p2_n = nodes["q"], nodes["p1"], nodes["p2"]
+        qq = jnp.einsum("ni,nj->nij", q_n, q_n)
+        p1p1 = jnp.einsum("ni,nj->nij", p1_n, p1_n)
+        p2p2 = jnp.einsum("ni,nj->nij", p2_n, p2_n)
+
         zeta = jnp.asarray(zeta, dtype=jnp.complex128 if w.dtype == jnp.float64 else jnp.complex64)
         beta = jnp.atleast_1d(jnp.asarray(beta))
         nH = beta.shape[0]
@@ -149,7 +172,7 @@ def compile_case_solver(fowt, n_iter=15, include_aero=True, device=None):
         pDyn = pDyn * wet[None, :, None]
 
         # ----- Froude-Krylov + added-mass inertial excitation -----
-        if nodes["mcf"]:
+        if mcf:
             F3 = jnp.einsum("nijw,hnjw->hnwi", nodes["imat"], ud)
         else:
             F3 = jnp.einsum("nij,hnjw->hnwi", nodes["imat"], ud)
@@ -215,6 +238,25 @@ def compile_case_solver(fowt, n_iter=15, include_aero=True, device=None):
         Zinv = jnp.linalg.inv(Z)
         F_all = Fexc + jax.vmap(lambda ih: drag_excitation(Bmat, ih))(jnp.arange(nH))
         return jnp.einsum("wij,hjw->hiw", Zinv, F_all)
+
+    return solve
+
+
+def compile_case_solver(fowt, n_iter=15, include_aero=True, device=None):
+    """Case-solve function for one (already positioned) FOWT with its
+    design baked in: solve(zeta, beta) -> Xi [nH, 6, nw].
+
+    ``calcStatics`` and ``calcHydroConstants`` must have run.  This is
+    the single-design convenience wrapper around
+    :func:`make_parametric_solver`; sweeps should stack
+    :func:`design_params` outputs and vmap the parametric solver
+    directly so all variants share one executable.
+    """
+    params, static = design_params(fowt, include_aero=include_aero, device=device)
+    solve_p = make_parametric_solver(static, n_iter=n_iter)
+
+    def solve(zeta, beta):
+        return solve_p(params, zeta, beta)
 
     return solve
 

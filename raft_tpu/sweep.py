@@ -26,7 +26,6 @@ import jax
 import jax.numpy as jnp
 
 from .core.model import Model
-from .parallel.case_solve import compile_case_solver
 from .ops import waves
 
 
@@ -61,15 +60,20 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0):
 
     Returns
     -------
-    dict with 'grid' (list of value tuples) and 'metrics': arrays
-    [n_designs, n_cases, 6] of motion std-devs, plus 'Xi' amplitudes.
+    dict with 'grid' (the factorial list of value tuples) and
+    'motion_std' [n_designs, n_cases, 6] motion standard deviations.
     """
+    from .parallel.case_solve import design_params, make_parametric_solver
+
     combos = list(itertools.product(*[v for _, v in axes]))
     n_designs = len(combos)
-    stds = []
     grid = []
 
-    batched = None
+    # host pass: compile every design variant into a params pytree
+    # (identical topology -> identical shapes -> ONE jitted executable)
+    params_list = []
+    static = None
+    template = None
     for ic, combo in enumerate(combos):
         design = copy.deepcopy(base_design)
         for (path, _), val in zip(axes, combo):
@@ -81,30 +85,31 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0):
         fowt.setPosition(np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0]))
         fowt.calcStatics()
         fowt.calcHydroConstants()
-
-        solve = compile_case_solver(fowt, n_iter=n_iter, include_aero=False,
-                                    device=device)
-        # geometry enters the solver as closed-over constants, so each
-        # design variant traces its own executable (same shapes, so XLA
-        # compilation is fast after the first); passing geometry as traced
-        # arguments to share one executable is the planned refinement
-        batched = jax.jit(jax.vmap(solve))
-
-        w = jnp.asarray(fowt.w)
-        zetas, betas = [], []
-        for ss in sea_states:
-            Hs, Tp = ss[0], ss[1]
-            beta = np.radians(ss[2]) if len(ss) > 2 else 0.0
-            S = waves.jonswap(w, Hs, Tp)
-            zetas.append(jnp.sqrt(2.0 * S * fowt.dw) + 0j)
-            betas.append(jnp.array([beta]))
-        zetas = jnp.stack(zetas)[:, None, :]
-        betas = jnp.stack(betas)
-
-        Xi = batched(zetas, betas)  # [ncase, 1, 6, nw]
-        std = jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, 0]) ** 2, axis=-1))  # [ncase, 6]
-        stds.append(np.asarray(std))
+        p, s = design_params(fowt, include_aero=False, device=device)
+        params_list.append(p)
+        static = s
+        template = fowt
         if display:
-            print(f"design {ic+1}/{n_designs}: {combo}")
+            print(f"compiled design {ic+1}/{n_designs}: {combo}")
 
-    return {"grid": grid, "motion_std": np.stack(stds)}
+    params_stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+
+    solve_p = make_parametric_solver(static, n_iter=n_iter)
+    # vmap axes: designs (params), then cases (waves) — one executable
+    batched = jax.jit(jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
+                               in_axes=(0, None, None)))
+
+    w = jnp.asarray(template.w)
+    zetas, betas = [], []
+    for ss in sea_states:
+        Hs, Tp = ss[0], ss[1]
+        beta = np.radians(ss[2]) if len(ss) > 2 else 0.0
+        S = waves.jonswap(w, Hs, Tp)
+        zetas.append(jnp.sqrt(2.0 * S * template.dw) + 0j)
+        betas.append(jnp.array([beta]))
+    zetas = jnp.stack(zetas)[:, None, :]
+    betas = jnp.stack(betas)
+
+    Xi = batched(params_stacked, zetas, betas)  # [ndesign, ncase, 1, 6, nw]
+    std = jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1))  # [nd, nc, 6]
+    return {"grid": grid, "motion_std": np.asarray(std)}
