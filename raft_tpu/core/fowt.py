@@ -537,7 +537,15 @@ class FOWT:
             self._hydro[i] = hydro
             if not cm.topo.pot_mod:
                 A = A + hydro["A_hydro"]
-        self.A_hydro_morison = np.asarray(A)
+        A = np.asarray(A)
+
+        # underwater rotors contribute whole-rotor added mass (raft_fowt.py:873-880)
+        for rot in self.rotorList:
+            if rot.r3[2] + getattr(rot, "R_rot", 0.0) < 0 and rot.bem is not None:
+                A_rot, _ = rot.calcHydroConstants(rho=self.rho_water, g=self.g)
+                A = A + np.asarray(transforms.translate_matrix_6to6(
+                    jnp.asarray(A_rot), jnp.asarray(rot.r3 - self.r6[:3])))
+        self.A_hydro_morison = A
         return self.A_hydro_morison
 
     def calcHydroExcitation(self, case, memberList=None, dgamma=0):
@@ -606,7 +614,27 @@ class FOWT:
                 for ih in range(nH):
                     self.F_BEM[ih] = wamit_io.bem_excitation(self, ih, ch[ih])
 
-        self.F_hydro_iner = np.asarray(F_iner)
+        F_iner_np = np.array(F_iner)  # writable copy (np.asarray of a jax array is read-only)
+
+        # inertial excitation on submerged rotors (raft_fowt.py:1127-1149)
+        for rot in self.rotorList:
+            if rot.r3[2] < 0 and getattr(rot, "I_hydro", None) is not None \
+                    and np.any(rot.I_hydro):
+                I_hydro = np.array(transforms.rotate_matrix6(
+                    jnp.asarray(rot.I_hydro), jnp.asarray(rot.R_q)))
+                for ih in range(nH):
+                    _, ud_hub, _ = waves.wave_kinematics(
+                        zetaj[ih], float(self.beta[ih]), wj, kj, self.depth,
+                        jnp.asarray(rot.r3)[None, :], rho=self.rho_water, g=self.g)
+                    ud_hub = np.array(ud_hub)[0]  # [3,nw] (writable copy)
+                    f3 = I_hydro[:3, :3] @ ud_hub
+                    offs = jnp.asarray(rot.r3 - self.r6[:3])
+                    f6 = np.array(transforms.translate_force_3to6(
+                        jnp.asarray(f3.T), offs[None, :])).T  # [6,nw]
+                    f6[3:] += I_hydro[3:, :3] @ ud_hub
+                    F_iner_np[ih] += f6
+
+        self.F_hydro_iner = F_iner_np
         return self.F_hydro_iner
 
     def calcHydroLinearization(self, Xi):
@@ -675,6 +703,7 @@ class FOWT:
         self.f_aero0 = np.zeros([6, self.nrotors])
         self.B_gyro = np.zeros([6, 6, self.nrotors])
 
+        self.cav = [0] if any(r.r3[2] < 0 for r in self.rotorList) else []
         if turbine_status != "operating":
             return
         for ir, rot in enumerate(self.rotorList):
@@ -687,6 +716,8 @@ class FOWT:
             if rot.aeroServoMod > 0 and speed > 0.0:
                 from . import aero_interface
                 aero_interface.apply_rotor_aero(self, rot, ir, case, current, speed)
+            if current and rot.bem is not None and speed > 0.0:
+                self.cav = rot.calcCavitation(case)  # (raft_fowt.py:827)
 
     # ------------------------------------------------------------------
     # potential flow (BEM)

@@ -67,11 +67,14 @@ def _pv_integral(A, V, n_gauss=200):
         g1 = np.where(np.abs(t1 - 1.0) > 1e-12, (f1 - f_at_1) / (t1 - 1.0), 0.0)
     part1 = np.sum(g1 * wq, axis=-1)
 
-    # oscillation-aware tail: shared panel grid per call, panel length
-    # <= quarter period of the fastest oscillation present
+    # oscillation-aware tail: shared panel grid per call.  The cutoff T
+    # must cover the SLOWEST-decaying entry (V closest to zero) — sizing
+    # it from the fastest decay truncates the near-free-surface values.
     A_max = float(np.max(A))
-    V_min = float(np.min(-np.maximum(-V, 1e-6)))  # most-negative V
-    T = 2.0 + min(max(10.0, 40.0 / max(-V_min, 0.15)), max(10.0, 600.0 / max(A_max, 1.0)))
+    V_slow = float(np.max(np.minimum(V, -1e-6)))  # closest to 0
+    T_decay = max(10.0, 40.0 / max(-V_slow, 0.15))
+    T_osc = max(10.0, 600.0 / max(A_max, 1.0))  # oscillation cancels the far tail
+    T = 2.0 + min(T_decay, T_osc)
     T = min(T, 400.0)
     panel_len = min(1.0, np.pi / (2.0 * max(A_max, 1e-6) + 1.0))
     n_panels = int(np.ceil((T - 2.0) / panel_len))
@@ -95,7 +98,8 @@ class GreenTable:
     costs ~a minute.
     """
 
-    _CACHE = os.path.expanduser("~/.cache/raft_tpu/greens_table_v2.npz")
+    _RULE_VERSION = 3  # bump whenever the quadrature rule changes
+    _CACHE = os.path.expanduser("~/.cache/raft_tpu/greens_table_v3.npz")
 
     def __init__(self, n_gauss=200):
         # grids: A quadratic clustering near 0, V log-like clustering near 0
@@ -104,15 +108,17 @@ class GreenTable:
         v_lin = np.linspace(0.0, 1.0, _NV)
         self.V_grid = _V_MIN * v_lin**2  # 0 .. V_MIN (descending values)
 
+        self.I0 = None
         if os.path.exists(self._CACHE):
             dat = np.load(self._CACHE)
-            if (dat["A_grid"].shape == self.A_grid.shape
+            if ("rule_version" in dat
+                    and int(dat["rule_version"]) == self._RULE_VERSION
+                    and int(dat["n_gauss"]) == n_gauss
+                    and dat["A_grid"].shape == self.A_grid.shape
                     and np.allclose(dat["A_grid"], self.A_grid)
                     and np.allclose(dat["V_grid"], self.V_grid)):
                 self.I0 = dat["I0"]
-            else:
-                self.I0 = self._build(n_gauss)
-        else:
+        if self.I0 is None:
             self.I0 = self._build(n_gauss)
 
         # derivative tables via central differences of the (smooth) table
@@ -132,7 +138,8 @@ class GreenTable:
             I0[i, :] = _pv_integral(np.full(_NV, a), Vg, n_gauss=n_gauss)
         try:
             os.makedirs(os.path.dirname(self._CACHE), exist_ok=True)
-            np.savez_compressed(self._CACHE, A_grid=self.A_grid, V_grid=self.V_grid, I0=I0)
+            np.savez_compressed(self._CACHE, A_grid=self.A_grid, V_grid=self.V_grid,
+                                I0=I0, rule_version=self._RULE_VERSION, n_gauss=n_gauss)
         except OSError:
             pass
         return I0

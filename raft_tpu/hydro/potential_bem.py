@@ -56,18 +56,20 @@ def _rankine_matrices(centroids, areas, normals):
     d1 = Ci - Cj_im
     r1 = np.linalg.norm(d1, axis=-1)
 
-    np.fill_diagonal(r, 1.0)
-    S_direct = A[None, :] / r
-    # equivalent-square self-influence of 1/r: for a unit square,
-    # ∬ dS/r from the centroid = 4*ln(1+sqrt(2)) ≈ 3.52549; scales as sqrt(A)
-    np.fill_diagonal(S_direct, 3.52549 * np.sqrt(A))
-    S0 = S_direct + A[None, :] / r1
+    # Desingularized centroid rule: S = A / sqrt(r^2 + eps*A) with
+    # eps = 1/3.52549^2 so that r->0 recovers the analytic
+    # equivalent-square self-integral ∬ dS/r = 4*ln(1+sqrt(2))*sqrt(A)
+    # ~ 3.52549 sqrt(A), while r >> panel size recovers A/r.  This keeps
+    # adjacent-panel and near-surface-image integrals (r ~ panel scale,
+    # where the bare one-point rule errs by tens of percent) accurate.
+    eps = A[None, :] / 3.52549**2
+    S0 = A[None, :] / np.sqrt(r**2 + eps) + A[None, :] / np.sqrt(r1**2 + eps)
 
-    # gradient wrt field point p=i: ∇(1/r) = -d/r^3
-    G_direct = -d / r[..., None] ** 3 * A[None, :, None]
+    # gradient wrt field point p=i, desingularized consistently
+    G_direct = -d / (r**2 + eps)[..., None] ** 1.5 * A[None, :, None]
     idx = np.arange(n)
     G_direct[idx, idx, :] = 0.0  # self term handled by the 2*pi jump
-    G_image = -d1 / r1[..., None] ** 3 * A[None, :, None]
+    G_image = -d1 / (r1**2 + eps)[..., None] ** 1.5 * A[None, :, None]
     D0 = np.einsum("ijk,ik->ij", G_direct + G_image, Nrm)
     return S0, D0, r, r1
 

@@ -1,0 +1,200 @@
+"""Design-dict and file-format utilities (reference helpers parity).
+
+Host-side helpers from the tail of the reference's helpers.py that
+don't belong in the physics kernels: unique case-heading extraction for
+BEM preprocessing, tower-base stress PSDs, parametric case-table
+builders, the IEA-ontology turbine YAML converter, WAMIT ``.p2``
+second-order output reading, and YAML-safe design-dict cleaning.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .schema import get_from_dict
+from .ops import waves as waves_ops
+
+
+def get_unique_case_headings(keys, values):
+    """Unique wave headings + step/count for BEM preprocessing
+    (helpers.getUniqueCaseHeadings, helpers.py:932-964)."""
+    caseHeadings = []
+    data = [dict(zip(keys, v)) for v in values]
+    wave_headings = [float(dh["wave_heading"]) for dh in data]
+    wave_headings += [float(dh["wave_heading2"]) for dh in data if "wave_heading2" in dh]
+    for wh in wave_headings:
+        if wh not in caseHeadings:
+            caseHeadings.append(wh)
+    maxH, minH = max(caseHeadings), min(caseHeadings)
+    if len(caseHeadings) == 2:
+        step, n = maxH - minH, 2
+    elif len(caseHeadings) > 2:
+        step = float(np.min(np.abs(np.diff(np.sort(caseHeadings)))))
+        n = int((maxH - minH) / step + 1)
+    else:
+        step, n = 0, 1
+    return caseHeadings, step, n
+
+
+def tower_base_stress_psd(TBFA, TBSS, frequencies, angles=None, d=10.0, thickness=0.083):
+    """Axial-stress PSD around the tower-base circumference from fore-aft
+    and side-side bending amplitude spectra (helpers.getSigmaXPSD)."""
+    import jax.numpy as jnp
+
+    if angles is None:
+        angles = np.linspace(0, 2 * np.pi, 50)
+    angleFA, TBFAm = np.meshgrid(angles, TBFA)
+    angleSS, TBSSm = np.meshgrid(angles, TBSS)
+    Izz = np.pi / 8 * thickness * d**3  # thin-walled bending inertia
+    sigmaX = ((TBFAm * np.cos(angleFA) - TBSSm * np.sin(angleSS)) * d / 2) / Izz
+    # reference quirk kept: getPSD receives [nfreq, nangle] and sums its
+    # leading axis, returning one value per circumferential angle
+    psd = np.asarray(waves_ops.psd(jnp.asarray(sigmaX / 1e6), frequencies[1] - frequencies[0]))
+    ANG, FRQ = np.meshgrid(angles, frequencies)
+    return psd, ANG, FRQ
+
+
+# case-table column indices in the reference's 14-column case format
+_CASE_COLS = {"wind_speed": 0, "wind_heading": 1, "wave_period1": 6, "wave_height1": 7,
+              "wave_heading1": 8, "wave_period2": 11, "wave_height2": 12,
+              "wave_heading2": 13}
+
+
+def parametric_case_builder(design, axis, start, increment, count):
+    """Append load cases sweeping one case-table column
+    (generalized form of helpers.parametricAnalysisBuilder's per-type
+    blocks; ``axis`` is a key of the case table or a column index)."""
+    col = _CASE_COLS.get(axis, axis if isinstance(axis, int) else None)
+    if col is None:
+        col = list(design["cases"]["keys"]).index(axis)
+    design["cases"]["data"][0][col] = start
+    for i in range(count):
+        row = list(design["cases"]["data"][0])
+        row[col] += increment * (i + 1)
+        design["cases"]["data"].append(row)
+    return design
+
+
+def convert_iea_turbine_yaml(fname_turbine, n_span=30):
+    """IEA wind-turbine-ontology YAML -> RAFT turbine dict
+    (helpers.convertIEAturbineYAML2RAFT, helpers.py:777-926), without
+    the WISDEM validation dependency (plain YAML load)."""
+    import yaml
+
+    with open(fname_turbine) as f:
+        wt = yaml.safe_load(f)
+
+    d = {"blade": {}, "airfoils": [], "env": {}}
+    Rhub = 0.5 * wt["components"]["hub"]["diameter"]
+    d["precone"] = np.rad2deg(wt["components"]["hub"]["cone_angle"])
+    d["shaft_tilt"] = np.rad2deg(wt["components"]["nacelle"]["drivetrain"]["uptilt"])
+    d["overhang"] = wt["components"]["nacelle"]["drivetrain"]["overhang"]
+    d["nBlades"] = wt["assembly"]["number_of_blades"]
+
+    grid = np.linspace(0.0, 1.0, n_span)
+    blade = wt["components"]["blade"]["outer_shape_bem"]
+    rotor_diameter = wt["assembly"].get("rotor_diameter", 0.0)
+    axis = np.zeros((n_span, 3))
+    for j, ax in enumerate(("x", "y", "z")):
+        axis[:, j] = np.interp(grid, blade["reference_axis"][ax]["grid"],
+                               blade["reference_axis"][ax]["values"])
+    if rotor_diameter:
+        seg = np.diff(axis, axis=0)
+        arc = np.concatenate([[0.0], np.cumsum(np.linalg.norm(seg, axis=1))])
+        axis[:, 2] = axis[:, 2] * rotor_diameter / ((arc[-1] + Rhub) * 2.0)
+
+    d["blade"]["r"] = (axis[1:-1, 2] + Rhub).tolist()
+    d["blade"]["Rtip"] = float(axis[-1, 2] + Rhub)
+    d["blade"]["chord"] = np.interp(grid[1:-1], blade["chord"]["grid"],
+                                    blade["chord"]["values"]).tolist()
+    d["blade"]["theta"] = np.rad2deg(np.interp(grid[1:-1], blade["twist"]["grid"],
+                                               blade["twist"]["values"])).tolist()
+    d["blade"]["precurve"] = axis[1:-1, 0].tolist()
+    d["blade"]["precurveTip"] = float(axis[-1, 0])
+    d["blade"]["presweep"] = axis[1:-1, 1].tolist()
+    d["blade"]["presweepTip"] = float(axis[-1, 1])
+
+    hh = wt["assembly"].get("hub_height", 0.0)
+    if hh:
+        d["Zhub"] = hh
+    else:
+        d["Zhub"] = (wt["components"]["tower"]["outer_shape_bem"]["reference_axis"]["z"]["values"][-1]
+                     + wt["components"]["nacelle"]["drivetrain"]["distance_tt_hub"])
+    d["Rhub"] = Rhub
+
+    env = wt.get("environment", {})
+    d["env"]["rho"] = env.get("air_density", 1.225)
+    d["env"]["mu"] = env.get("air_dyn_viscosity", 1.81e-5)
+    d["env"]["shearExp"] = env.get("shear_exp", 0.12)
+
+    d["blade"]["airfoils"] = {"grid": blade["airfoil_position"]["grid"],
+                              "labels": blade["airfoil_position"]["labels"]}
+    for af in wt.get("airfoils", []):
+        afd = {"name": af["name"], "relative_thickness": af["relative_thickness"],
+               "key": ["alpha", "c_l", "c_d", "c_m"], "data": []}
+        pol = af["polars"][0]
+        if len(af["polars"]) > 1:
+            print(f"Warning for airfoil {af['name']}, only one polar entry is used (the first).")
+        for j in range(len(pol["c_l"]["grid"])):
+            if (pol["c_l"]["grid"][j] == pol["c_d"]["grid"][j]
+                    and pol["c_l"]["grid"][j] == pol["c_m"]["grid"][j]):
+                afd["data"].append([np.rad2deg(pol["c_l"]["grid"][j]),
+                                    pol["c_l"]["values"][j],
+                                    pol["c_d"]["values"][j],
+                                    pol["c_m"]["values"][j]])
+        d["airfoils"].append(afd)
+    return d
+
+
+def read_wamit_p2(inFl, rho=1.0, L=1.0, g=1.0):
+    """WAMIT .p2 second-order output reader (helpers.readWAMIT_p2)."""
+    data = np.loadtxt(inFl)
+    head = np.unique(data[:, 1])
+    numHead = len(head)
+    period = np.unique(data[:, 0])
+    stringDoF = ["surge", "sway", "heave", "roll", "pitch", "yaw"]
+    k_ULEN = [2, 2, 2, 3, 3, 3]
+    W2 = {}
+    for iDoF, DoF in enumerate(stringDoF):
+        aux = data[data[:, 2] == iDoF + 1, :]
+        aux = aux[np.lexsort((aux[:, 1], aux[:, 0]))]
+        re = aux[:, 5].reshape(-1, numHead)
+        im = aux[:, 6].reshape(-1, numHead)
+        W2[DoF] = (re + 1j * im) * rho * g * L ** k_ULEN[iDoF]
+    W2["period"] = period
+    W2["heading"] = head
+    return W2
+
+
+def adjust_mooring(ms, design):
+    """Write a CompiledMooring's state back into the design dict
+    (helpers.adjustMooring equivalent for our mooring representation)."""
+    design["mooring"]["water_depth"] = float(np.asarray(ms.params.depth))
+    locs = np.asarray(ms.params.p_loc)
+    for i, pt in enumerate(design["mooring"]["points"][: ms.n_points]):
+        pt["location"] = locs[i].tolist()
+    Ls = np.asarray(ms.params.L)
+    for i, ln in enumerate(design["mooring"]["lines"][: ms.n_lines]):
+        ln["length"] = float(Ls[i])
+    EA = np.asarray(ms.params.EA)
+    for i, lt in enumerate(design["mooring"].get("line_types", [])):
+        if i < ms.n_lines:
+            lt["stiffness"] = float(EA[i])
+    return design
+
+
+def clean_raft_dict(design):
+    """Recursively coerce numpy scalars/arrays to plain python types so
+    the design dict round-trips through YAML (helpers.cleanRAFTdict,
+    simplified to a generic recursion with identical effect)."""
+    if isinstance(design, dict):
+        return {k: clean_raft_dict(v) for k, v in design.items()}
+    if isinstance(design, (list, tuple)):
+        return [clean_raft_dict(v) for v in design]
+    if isinstance(design, np.ndarray):
+        return [clean_raft_dict(v) for v in design.tolist()]
+    if isinstance(design, (np.floating,)):
+        return float(design)
+    if isinstance(design, (np.integer,)):
+        return int(design)
+    return design

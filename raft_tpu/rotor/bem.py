@@ -195,12 +195,15 @@ def _solve_element(Vx, Vy, r_i, chord_i, theta_i, pitch, rotor, cl_i, cd_i, n_it
     return phi, a, ap, cn, ct
 
 
-def _distributed_loads(rotor: BEMRotor, Uinf, Omega, pitch, azimuth, tilt, yaw):
-    """Np, Tp [N/m] along the span for one blade at one azimuth angle.
+def _inflow_components(rotor: BEMRotor, Uinf, Omega, azimuth, tilt, yaw):
+    """Blade-frame inflow at every element for one azimuth.
 
     Geometry/conventions follow CCBlade: power-law shear from hub
     height, yaw about z, tilt about y, azimuth about the shaft axis,
-    total cone = precone + local precurve slope.
+    total cone = precone + local precurve slope.  Returns
+    (Vx, Vy, parked, cone, x_az, y_az, z_az); ``parked`` marks
+    elements where the BEM residual is singular (Vy ~ 0, e.g. a
+    stopped rotor) and the static inflow triangle must be used.
     """
     r = rotor.r
     precurve = rotor.precurve
@@ -225,23 +228,24 @@ def _distributed_loads(rotor: BEMRotor, Uinf, Omega, pitch, azimuth, tilt, yaw):
     V = Uinf * jnp.power(jnp.maximum((rotor.hub_height + height) / rotor.hub_height, 1e-3),
                          rotor.shear_exp)
 
-    # wind components in the local blade frame
     Vwind_x = V * ((cy * st * ca + sy * sa) * sc + cy * ct * cc)
     Vwind_y = V * (cy * st * sa - sy * ca)
-    # rotational speed contribution
     Vrot_x = -Omega * y_az * sc
     Vrot_y = Omega * z_az
 
     Vx_raw = Vwind_x + Vrot_x
     Vy_raw = Vwind_y + Vrot_y
-    # parked / no-rotation elements (Omega ~ 0): the BEM residual is
-    # singular (lam -> 0 gives inf-inf in the bracketing), so those
-    # elements bypass the induction solve and use the static inflow
-    # triangle phi = atan2(Vx, Vy) with a = a' = 0, like CCBlade's
-    # special-case handling of Vy == 0
     parked = jnp.abs(Vy_raw) < 1e-4 * jnp.maximum(jnp.abs(Vx_raw), 1e-3)
     Vy = jnp.where(jnp.abs(Vy_raw) < 1e-6, 1e-6, Vy_raw)
     Vx = jnp.where(jnp.abs(Vx_raw) < 1e-6, 1e-6, Vx_raw)
+    return Vx, Vy, parked, cone, x_az, y_az, z_az
+
+
+def _distributed_loads(rotor: BEMRotor, Uinf, Omega, pitch, azimuth, tilt, yaw):
+    """Np, Tp [N/m] along the span for one blade at one azimuth angle."""
+    r = rotor.r
+    Vx, Vy, parked, cone, x_az, y_az, z_az = _inflow_components(
+        rotor, Uinf, Omega, azimuth, tilt, yaw)
 
     phi_s, a_s, ap_s, cn_s, ct_s = jax.vmap(
         lambda vx, vy, ri, ci, ti, cli, cdi: _solve_element(
@@ -313,6 +317,31 @@ def _integrate_hub_loads(rotor: BEMRotor, Np, Tp, cone, x_az, y_az, z_az, azimut
     Fy_h, Fz_h = ca * Fy - sa * Fz, sa * Fy + ca * Fz
     My_h, Mz_h = ca * My - sa * Mz, sa * My + ca * Mz
     return jnp.array([Fx, Fy_h, Fz_h, Mx, My_h, Mz_h])
+
+
+def distributed_inflow(rotor: BEMRotor, Uinf, Omega_radps, pitch_rad, azimuth,
+                       tilt=0.0, yaw=0.0):
+    """Per-element relative inflow speed W and angle of attack alpha [rad]
+    at one blade azimuth (the pieces of CCBlade.distributedAeroLoads the
+    cavitation check consumes, raft_rotor.py:671-676).  Shares the
+    inflow geometry and parked-element handling with evaluate()."""
+    r = rotor.r
+    Vx, Vy, parked, cone, x_az, y_az, z_az = _inflow_components(
+        rotor, Uinf, Omega_radps, azimuth, tilt, yaw)
+
+    phi_s, a_s, ap_s, _, _ = jax.vmap(
+        lambda vx, vy, ri, ci, ti, cli, cdi: _solve_element(
+            vx, vy, ri, ci, ti, pitch_rad, rotor, cli, cdi
+        )
+    )(Vx, Vy, r, rotor.chord, rotor.theta, rotor.cl_tab, rotor.cd_tab)
+
+    phi = jnp.where(parked, jnp.arctan2(Vx, Vy), phi_s)
+    a = jnp.where(parked, 0.0, a_s)
+    ap = jnp.where(parked, 0.0, ap_s)
+
+    W = jnp.sqrt((Vx * (1.0 - a)) ** 2 + (Vy * (1.0 + ap)) ** 2)
+    alpha = phi - (rotor.theta + pitch_rad)
+    return W, alpha
 
 
 def evaluate(rotor: BEMRotor, Uinf, Omega_radps, pitch_rad, tilt=0.0, yaw=0.0):

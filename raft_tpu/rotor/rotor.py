@@ -160,6 +160,119 @@ class Rotor:
                 self.setControlGains(turbine)
 
     # ------------------------------------------------------------------
+    # underwater-rotor hydrodynamics (MHK; raft_rotor.py:522-696)
+    # ------------------------------------------------------------------
+
+    def bladeGeometry2Member(self):
+        """Convert blade elements into rectangular strip members for
+        added-mass/buoyancy of underwater rotors (raft_rotor.py:522-562)."""
+        from ..structure import member as mstruct
+
+        self.bladeMemberList = []
+        if self.bem is None:
+            return self.bladeMemberList
+        pol = self._polars
+        airfoil_zero_heading = np.array([[0, -1, 0], [1, 0, 0], [0, 0, 1]]) @ self.q_rel
+        dr = (pol["Rtip"] - pol["Rhub"]) / pol["nr"]
+        for i in range(pol["nr"] - 1):
+            chord = float(pol["chord"][i])
+            rect_thick = (np.pi / 4) * chord * float(pol["r_thick"][i])
+            mem = {
+                "name": f"blade{i}", "type": 3,
+                "rA": (airfoil_zero_heading * (pol["r"][i] - dr / 2)).tolist(),
+                "rB": (airfoil_zero_heading * (pol["r"][i] + dr / 2)).tolist(),
+                "shape": "rect", "stations": [0, 1],
+                "d": [[chord, rect_thick], [chord, rect_thick]],
+                "gamma": float(pol["theta_deg"][i]),
+                "potMod": False,
+                "Cd": 0.0, "Ca": pol["Ca"][i].tolist(), "CdEnd": 0.0, "CaEnd": 0.0,
+                "t": 0.01, "rho_shell": 1850,
+            }
+            self.bladeMemberList.append(mem)
+        return self.bladeMemberList
+
+    @staticmethod
+    def _axis_rotation(axis, azimuth_deg):
+        """Rodrigues rotation matrix about ``axis`` by azimuth [deg]."""
+        c = np.cos(np.deg2rad(azimuth_deg))
+        s = np.sin(np.deg2rad(azimuth_deg))
+        a = np.asarray(axis, dtype=float)
+        return np.array([
+            [c + a[0]**2 * (1 - c), a[0]*a[1]*(1 - c) - a[2]*s, a[0]*a[2]*(1 - c) + a[1]*s],
+            [a[1]*a[0]*(1 - c) + a[2]*s, c + a[1]**2 * (1 - c), a[1]*a[2]*(1 - c) - a[0]*s],
+            [a[2]*a[0]*(1 - c) - a[1]*s, a[2]*a[1]*(1 - c) + a[0]*s, c + a[2]**2 * (1 - c)],
+        ])
+
+    def calcHydroConstants(self, dgamma=0, rho=1025.0, g=9.81):
+        """Whole-rotor added mass + inertial excitation about the hub
+        (raft_rotor.py:586-636): each blade strip member evaluated at
+        every blade azimuth and summed."""
+        from ..structure import member as mstruct
+
+        A_hydro = np.zeros([6, 6])
+        I_hydro = np.zeros([6, 6])
+        if not getattr(self, "bladeMemberList", None):
+            self.bladeGeometry2Member()
+        for mem_dict in getattr(self, "bladeMemberList", []):
+            rA0 = np.asarray(mem_dict["rA"], dtype=float)
+            rB0 = np.asarray(mem_dict["rB"], dtype=float)
+            for theta in np.atleast_1d(self.azimuths):
+                R = self._axis_rotation(self.q_rel, float(theta))
+                md = dict(mem_dict)
+                md["rA"] = (R @ rA0).tolist()
+                md["rB"] = (R @ rB0).tolist()
+                md["gamma"] = mem_dict["gamma"] + dgamma
+                cm = mstruct.compile_member(md)
+                pose = mstruct.member_pose(cm.topo, cm.geom)
+                # hub-relative coordinates: the z<0 submergence mask inside
+                # member_hydro_constants then counts the lower half of the
+                # rotor disc — matching the reference's literal behavior
+                # (Member.calcHydroConstants with relative rA0/rB0)
+                hyd = mstruct.member_hydro_constants(
+                    cm.topo, cm.geom, pose, r_ref=jnp.zeros(3), rho=rho, g=g,
+                )
+                A_hydro += np.asarray(hyd["A_hydro"])
+                I_hydro += np.asarray(hyd["I_hydro"])
+        self.A_hydro = A_hydro
+        self.I_hydro = I_hydro
+        return A_hydro, I_hydro
+
+    def calcCavitation(self, case, azimuth=0, clearance_margin=1.0, Patm=101325,
+                       Pvap=2500, error_on_cavitation=False):
+        """Blade-node cavitation margin sigma_crit + cpmin (negative =>
+        cavitation) for underwater rotors (raft_rotor.py:639-696)."""
+        from . import bem as _bem
+
+        if self.r3[2] >= 0:
+            raise ValueError("Hub Depth must be below the water surface to calculate cavitation")
+        pol = self._polars
+        Uhub = float(get_from_dict(case, "current_speed", shape=0, default=1.0))
+        Omega = float(np.interp(Uhub, self.Uhub, self.Omega_rpm)) * rpm2radps
+        pitch = float(np.radians(np.interp(Uhub, self.Uhub, self.pitch_deg)))
+
+        azimuths = np.atleast_1d(self.azimuths)
+        nr = pol["nr"]
+        cav_check = np.zeros([len(azimuths), nr])
+        rho = float(self.rho)
+        airfoil_dir = np.array([[0, -1, 0], [1, 0, 0], [0, 0, 1]]) @ self.q_rel
+        for a, azi in enumerate(azimuths):
+            W, alpha = _bem.distributed_inflow(self.bem, Uhub, Omega, pitch,
+                                               np.deg2rad(float(azi)))
+            W = np.asarray(W)
+            alpha = np.asarray(alpha)
+            R = self._axis_rotation(self.q_rel, float(azi))
+            z_nodes = (pol["r"][:, None] * airfoil_dir[None, :]) @ R.T[:, 2] + self.r3[2]
+            for n in range(nr):
+                cpmin_node = np.interp(alpha[n], pol["aoa_grid"], pol["cpmin_tab"][n])
+                sigma_crit = (Patm + rho * 9.81 * abs(z_nodes[n]) - Pvap) / (0.5 * rho * W[n]**2)
+                if error_on_cavitation and sigma_crit < -cpmin_node:
+                    raise ValueError(f"Cavitation occured at node {n} (first node = 0)")
+                cav_check[a, n] = sigma_crit + cpmin_node
+        if np.any(cav_check < 0.0):
+            print("WARNING: Cavitation check was run and found a blade node that has cavitation occuring")
+        return cav_check
+
+    # ------------------------------------------------------------------
     # controls (raft_rotor.py:770-784)
     # ------------------------------------------------------------------
 
