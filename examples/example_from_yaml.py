@@ -1,0 +1,53 @@
+"""Run a full analysis from a design YAML (reference examples/example_from_yaml.py).
+
+Usage:  python examples/example_from_yaml.py [design.yaml] [plot]
+
+Without arguments it uses the built-in demo spar so the example is
+fully self-contained.
+"""
+
+import sys
+
+import numpy as np
+
+
+def main():
+    import jax
+
+    try:  # prefer CPU for small interactive runs
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    jax.config.update("jax_enable_x64", True)
+
+    import raft_tpu
+
+    if len(sys.argv) > 1 and sys.argv[1].endswith((".yaml", ".yml")):
+        design = sys.argv[1]
+    else:
+        from raft_tpu.designs import demo_spar
+
+        design = demo_spar()
+
+    model = raft_tpu.Model(design)
+    model.analyzeUnloaded()
+    model.analyzeCases(display=1)
+    model.calcOutputs()
+    fns, modes = model.solveEigen(display=1)
+
+    m = model.results["case_metrics"][0][0]
+    print("\nCase 1 response statistics:")
+    for ch in ("surge", "heave", "pitch"):
+        print(f"  {ch:6s}: avg {m[ch + '_avg']: .3f}   std {m[ch + '_std']: .3f}")
+    print("Natural periods (s):", np.round(1.0 / np.real(fns), 1))
+
+    if "plot" in sys.argv:
+        import matplotlib.pyplot as plt
+
+        model.plotResponses()
+        model.plot()
+        plt.show()
+
+
+if __name__ == "__main__":
+    main()

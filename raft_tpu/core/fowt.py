@@ -716,8 +716,10 @@ class FOWT:
             if rot.aeroServoMod > 0 and speed > 0.0:
                 from . import aero_interface
                 aero_interface.apply_rotor_aero(self, rot, ir, case, current, speed)
-            if current and rot.bem is not None and speed > 0.0:
-                self.cav = rot.calcCavitation(case)  # (raft_fowt.py:827)
+                # cavitation check uses the rotor pose calcAero just set
+                # (raft_fowt.py:825-827)
+                if current and rot.bem is not None:
+                    self.cav = rot.calcCavitation(case)
 
     # ------------------------------------------------------------------
     # potential flow (BEM)

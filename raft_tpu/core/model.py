@@ -605,6 +605,16 @@ class Model:
         ax.set_zlabel("z (m)")
         return ax
 
+    def preprocess_HAMS(self, dw=0, wMax=0, dz=0, da=0, meshDir="BEM"):
+        """Export panel meshes (and BEM coefficients when solved) for
+        external use, e.g. OpenFAST preprocessing (raft_model.py:1310-1330).
+
+        With the native solver, the HullMesh.pnl plus the WAMIT-format
+        coefficient arrays already on the FOWT fill the same role as the
+        reference's HAMS output directory."""
+        for fowt in self.fowtList:
+            fowt.calcBEM(dw=dw, wMax=wMax, dz=dz, da=da, meshDir=meshDir)
+
     # ------------------------------------------------------------------
     # ballast adjustment (raft_model.py:1434-1624)
     # ------------------------------------------------------------------

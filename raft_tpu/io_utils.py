@@ -64,9 +64,14 @@ def parametric_case_builder(design, axis, start, increment, count):
     """Append load cases sweeping one case-table column
     (generalized form of helpers.parametricAnalysisBuilder's per-type
     blocks; ``axis`` is a key of the case table or a column index)."""
-    col = _CASE_COLS.get(axis, axis if isinstance(axis, int) else None)
-    if col is None:
+    # resolve against the design's actual key order first; the reference's
+    # hard-coded 14-column layout is only a fallback for legacy tables
+    if isinstance(axis, int):
+        col = axis
+    elif axis in design["cases"]["keys"]:
         col = list(design["cases"]["keys"]).index(axis)
+    else:
+        col = _CASE_COLS[axis]
     design["cases"]["data"][0][col] = start
     for i in range(count):
         row = list(design["cases"]["data"][0])

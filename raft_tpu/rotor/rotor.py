@@ -209,6 +209,12 @@ class Rotor:
         every blade azimuth and summed."""
         from ..structure import member as mstruct
 
+        cache_key = (float(dgamma), float(rho),
+                     tuple(float(a) for a in np.atleast_1d(self.azimuths)),
+                     len(getattr(self, "bladeMemberList", []) or []))
+        if getattr(self, "_hydro_cache_key", None) == cache_key:
+            return self.A_hydro, self.I_hydro  # geometry-only result; reuse
+
         A_hydro = np.zeros([6, 6])
         I_hydro = np.zeros([6, 6])
         if not getattr(self, "bladeMemberList", None):
@@ -235,6 +241,7 @@ class Rotor:
                 I_hydro += np.asarray(hyd["I_hydro"])
         self.A_hydro = A_hydro
         self.I_hydro = I_hydro
+        self._hydro_cache_key = cache_key
         return A_hydro, I_hydro
 
     def calcCavitation(self, case, azimuth=0, clearance_margin=1.0, Patm=101325,
@@ -255,9 +262,14 @@ class Rotor:
         cav_check = np.zeros([len(azimuths), nr])
         rho = float(self.rho)
         airfoil_dir = np.array([[0, -1, 0], [1, 0, 0], [0, 0, 1]]) @ self.q_rel
+        # current rotor orientation (set by the preceding calcAero/setYaw,
+        # like the reference's configured CCBlade object, raft_fowt.py:825)
+        tilt = float(np.arctan2(self.q[2], np.hypot(self.q[0], self.q[1])))
+        yaw_mis = float(np.arctan2(self.q[1], self.q[0]) - self.inflow_heading)
         for a, azi in enumerate(azimuths):
             W, alpha = _bem.distributed_inflow(self.bem, Uhub, Omega, pitch,
-                                               np.deg2rad(float(azi)))
+                                               np.deg2rad(float(azi)),
+                                               tilt=tilt, yaw=yaw_mis)
             W = np.asarray(W)
             alpha = np.asarray(alpha)
             R = self._axis_rotation(self.q_rel, float(azi))
