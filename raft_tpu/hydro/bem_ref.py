@@ -1,0 +1,225 @@
+"""Reference (slow, host-side) first-order panel BEM with rigorous quadrature.
+
+Purpose: a numerically careful NumPy implementation of the constant-panel
+source method with the infinite-depth free-surface Green function, used to
+
+* validate the fast table/JAX solver in :mod:`raft_tpu.hydro.potential_bem`
+  (which trades near-field quadrature for MXU-friendly one-point rules), and
+* serve as the accuracy anchor for the Hulme (1982) hemisphere benchmarks.
+
+Differences from the fast solver, all about integration accuracy:
+
+1.  Source panels are subdivided with an n x n Gauss-Legendre rule on the
+    bilinear quad map (triangles ride the same map with a repeated vertex),
+    so the Rankine image term ``1/r1`` and the wave term -- both of which are
+    (log-)singular where a waterline panel touches its own free-surface
+    image -- are integrated instead of sampled.
+2.  The ``1/r`` self-term uses the analytic equivalent-square value
+    ``4*ln(1+sqrt(2))*sqrt(A)``; its gradient self-term carries only the
+    ``-2*pi`` jump (flat-panel PV value is zero).
+3.  Normals are strictly outward (into the fluid); the boundary condition is
+    ``-2*pi*sigma + D sigma = v.n`` -- the textbook Hess & Smith form.
+
+The reference framework reaches these quantities by running the external
+Fortran HAMS executable (raft_fowt.py:623-650); nothing here is derived
+from that code.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+from numpy.polynomial.legendre import leggauss
+from scipy.special import j0 as _j0, j1 as _j1
+
+from .greens import green_table
+
+
+def _panel_vertices(mesh):
+    """[n, 4, 3] vertex array (triangles repeat their last vertex)."""
+    nodes = np.asarray(mesh.nodes)
+    out = []
+    for p in mesh.panels:
+        v = nodes[np.array(p[2:]) - 1]
+        if p[1] == 3:
+            v = np.vstack([v, v[-1:]])
+        out.append(v)
+    return np.asarray(out)
+
+
+def _subpoints(verts, n_gauss=4):
+    """Gauss points and scaled weights on each bilinear panel.
+
+    verts: [n, 4, 3] -> points [n, m, 3], weights [n, m] with
+    sum_m w = panel area (exactly, for flat panels).
+    """
+    x, w = leggauss(n_gauss)
+    u, v = np.meshgrid(x, x, indexing="ij")
+    wu, wv = np.meshgrid(w, w, indexing="ij")
+    u = u.ravel(); v = v.ravel()
+    ww = (wu * wv).ravel()
+
+    # bilinear shape functions on [-1,1]^2
+    N1 = 0.25 * (1 - u) * (1 - v)
+    N2 = 0.25 * (1 + u) * (1 - v)
+    N3 = 0.25 * (1 + u) * (1 + v)
+    N4 = 0.25 * (1 - u) * (1 + v)
+    N = np.stack([N1, N2, N3, N4], axis=0)          # [4, m]
+    dNu = 0.25 * np.stack([-(1 - v), (1 - v), (1 + v), -(1 + v)], axis=0)
+    dNv = 0.25 * np.stack([-(1 - u), -(1 + u), (1 + u), (1 - u)], axis=0)
+
+    pts = np.einsum("km,nkd->nmd", N, verts)
+    xu = np.einsum("km,nkd->nmd", dNu, verts)
+    xv = np.einsum("km,nkd->nmd", dNv, verts)
+    jac = np.linalg.norm(np.cross(xu, xv), axis=-1)  # [n, m]
+    return pts, jac * ww[None, :]
+
+
+def _table_eval(table, A, V):
+    """GreenTable lookups (the table's own bilinear rule), as NumPy."""
+    return (np.asarray(table.pv(A, V)),
+            np.asarray(table.pv_dA(A, V)),
+            np.asarray(table.pv_dV(A, V)))
+
+
+class RefPanelBEM:
+    """Slow accurate radiation/diffraction solver for one panel mesh."""
+
+    def __init__(self, mesh, rho=1025.0, g=9.81, ref_point=(0.0, 0.0, 0.0),
+                 n_gauss=4):
+        self.rho = float(rho)
+        self.g = float(g)
+        areas, centroids, normals = mesh.areas_centroids_normals()
+        verts = _panel_vertices(mesh)
+        keep = (areas > 1e-8) & (centroids[:, 2] < -1e-6)
+        self.areas = areas[keep]
+        self.C = centroids[keep]
+        self.N_hat = normals[keep]
+        self.verts = verts[keep]
+        # make normals strictly outward (into the fluid): for the wetted
+        # surface of a floating body closed by the z=0 lid, the divergence
+        # theorem gives sum(z * nz * A) = +V > 0 with outward normals
+        s = np.sum(self.C[:, 2] * self.N_hat[:, 2] * self.areas)
+        if s < 0:
+            self.N_hat = -self.N_hat
+            self.verts = self.verts[:, ::-1, :]
+        self.n = len(self.areas)
+        self.ref = np.asarray(ref_point, dtype=float)
+
+        self.pts, self.wts = _subpoints(self.verts, n_gauss)  # [n,m,3],[n,m]
+
+        lever = self.C - self.ref[None, :]
+        self.modes = np.zeros((6, self.n))
+        self.modes[0:3] = self.N_hat.T
+        self.modes[3:6] = np.cross(lever, self.N_hat).T
+
+        self.table = green_table()
+        self._S0, self._D0 = self._rankine()
+
+    # ------------------------------------------------------------------
+
+    def _rankine(self):
+        """S0[i,j] = subpanel-quadrature of 1/r + 1/r1, D0 its normal
+        gradient at the collocation point; exact-square self 1/r term."""
+        C = self.C                       # [n,3] collocation
+        P = self.pts                     # [n,m,3] source subpoints
+        W = self.wts                     # [n,m]
+        n = self.n
+
+        d = C[:, None, None, :] - P[None, :, :, :]          # [i,j,m,3]
+        r = np.linalg.norm(d, axis=-1)
+        Pim = P * np.array([1.0, 1.0, -1.0])
+        d1 = C[:, None, None, :] - Pim[None, :, :, :]
+        r1 = np.linalg.norm(d1, axis=-1)
+        r1 = np.maximum(r1, 1e-12)
+
+        idx = np.arange(n)
+        inv_r = 1.0 / np.maximum(r, 1e-12)
+        S_direct = np.einsum("ijm,jm->ij", inv_r, W)
+        # analytic equivalent-square self term for the 1/r part
+        S_direct[idx, idx] = 4.0 * np.log(1.0 + np.sqrt(2.0)) * np.sqrt(self.areas)
+        S_image = np.einsum("ijm,jm->ij", 1.0 / r1, W)
+        S0 = S_direct + S_image
+
+        g_dir = -d / np.maximum(r, 1e-12)[..., None] ** 3
+        D_direct = np.einsum("ijmd,jm,id->ij", g_dir, W, self.N_hat)
+        D_direct[idx, idx] = 0.0       # flat-panel PV value; jump added in solve
+        g_im = -d1 / r1[..., None] ** 3
+        D_image = np.einsum("ijmd,jm,id->ij", g_im, W, self.N_hat)
+        return S0, D_image + D_direct
+
+    def _wave(self, k):
+        """Subpanel-quadrature wave-part S_w, D_w (complex [n,n])."""
+        C = self.C
+        P = self.pts
+        W = self.wts
+
+        dxy = C[:, None, None, :2] - P[None, :, :, :2]
+        Rh = np.linalg.norm(dxy, axis=-1)
+        A = k * Rh
+        V = k * (C[:, None, None, 2] + P[None, :, :, 2])
+        V = np.minimum(V, -1e-12)
+
+        I0, dIdA, dIdV = _table_eval(self.table, A, V)
+        j0A = _j0(A)
+        j1A = _j1(A)
+        expV = np.exp(np.clip(V, -200.0, 0.0))
+
+        Gw = 2.0 * k * I0 + 2j * np.pi * k * expV * j0A
+        dG_dA = 2.0 * k * dIdA - 2j * np.pi * k * expV * j1A
+        dG_dV = 2.0 * k * dIdV + 2j * np.pi * k * expV * j0A
+
+        e_xy = dxy / (Rh[..., None] + 1e-12)
+        gx = dG_dA * k * e_xy[..., 0]
+        gy = dG_dA * k * e_xy[..., 1]
+        gz = dG_dV * k
+
+        S_w = np.einsum("ijm,jm->ij", Gw, W)
+        D_w = np.einsum("ijm,jm->ij",
+                        gx * self.N_hat[:, None, None, 0]
+                        + gy * self.N_hat[:, None, None, 1]
+                        + gz * self.N_hat[:, None, None, 2], W)
+        return S_w, D_w
+
+    # ------------------------------------------------------------------
+
+    def solve(self, w, k, headings_deg=(0.0,)):
+        """(A [6,6,nw], B [6,6,nw], X [nheads,6,nw]) per unit amplitude."""
+        w_np = np.atleast_1d(np.asarray(w, dtype=float))
+        k_np = np.atleast_1d(np.asarray(k, dtype=float))
+        nw = len(w_np)
+        heads = np.radians(np.atleast_1d(np.asarray(headings_deg, dtype=float)))
+
+        A_out = np.zeros([6, 6, nw])
+        B_out = np.zeros([6, 6, nw])
+        X_out = np.zeros([len(heads), 6, nw], dtype=complex)
+
+        Wv = self.wts.sum(axis=1)        # quadrature panel areas
+        for i in range(nw):
+            wi, ki = w_np[i], k_np[i]
+            S_w, D_w = self._wave(ki)
+            S = self._S0 + S_w
+            D = self._D0 + D_w
+            lhs = -2.0 * np.pi * np.eye(self.n) + D
+            sigma = np.linalg.solve(lhs, self.modes.T.astype(complex))  # [n,6]
+            phi = S @ sigma                                             # [n,6]
+
+            # F_mj = -i w rho Int phi_j n_m dS ;  F = (i w A - B) v
+            F = -1j * wi * self.rho * np.einsum("mn,nj,n->mj", self.modes, phi, Wv)
+            A_out[:, :, i] = np.imag(F) / wi
+            B_out[:, :, i] = -np.real(F)
+
+            # Haskind excitation from the radiation potentials
+            for ih, bh in enumerate(heads):
+                kx = ki * (self.C[:, 0] * np.cos(bh) + self.C[:, 1] * np.sin(bh))
+                phi0 = (self.g / wi) * np.exp(ki * self.C[:, 2]) * np.exp(-1j * kx)
+                grad = np.stack([
+                    -1j * ki * np.cos(bh) * phi0,
+                    -1j * ki * np.sin(bh) * phi0,
+                    ki * phi0,
+                ], axis=-1)
+                dphi0_dn = np.einsum("nd,nd->n", grad, self.N_hat)
+                X_out[ih, :, i] = -1j * wi * self.rho * (
+                    np.einsum("mn,n,n->m", self.modes, phi0, Wv)
+                    - np.einsum("nm,n,n->m", phi, dphi0_dn, Wv)
+                )
+        return A_out, B_out, X_out

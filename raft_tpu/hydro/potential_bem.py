@@ -68,7 +68,7 @@ def _rankine_matrices(centroids, areas, normals):
     # gradient wrt field point p=i, desingularized consistently
     G_direct = -d / (r**2 + eps)[..., None] ** 1.5 * A[None, :, None]
     idx = np.arange(n)
-    G_direct[idx, idx, :] = 0.0  # self term handled by the 2*pi jump
+    G_direct[idx, idx, :] = 0.0  # flat-panel PV value; the -2*pi jump is added in solve()
     G_image = -d1 / (r1**2 + eps)[..., None] ** 1.5 * A[None, :, None]
     D0 = np.einsum("ijk,ik->ij", G_direct + G_image, Nrm)
     return S0, D0, r, r1
@@ -117,10 +117,11 @@ class PanelBEM:
         self.table = green_table()
 
     def _orient_normals(self):
-        """Ensure normals point out of the body (into the fluid):
-        divergence theorem gives sum(z * nz * A) = -V < 0 for outward."""
+        """Ensure normals point out of the body (into the fluid): for the
+        wetted surface closed by the z=0 lid, the divergence theorem gives
+        sum(z * nz * A) = +V > 0 with outward normals."""
         s = np.sum(self.centroids[:, 2] * self.normals[:, 2] * self.areas)
-        if s > 0:
+        if s < 0:
             self.normals = -self.normals
 
     # ------------------------------------------------------------------
@@ -175,11 +176,14 @@ class PanelBEM:
             S_w, D_w = self._wave_matrices(ki)
             S = (self.S0 + S_w).astype(jnp.complex128)
             D = (self.D0 + D_w).astype(jnp.complex128)
-            lhs = 2.0 * jnp.pi * jnp.eye(self.n, dtype=jnp.complex128) + D
+            # Hess & Smith with outward normals (fluid side): the flat-
+            # panel self gradient carries only the -2*pi jump
+            lhs = -2.0 * jnp.pi * jnp.eye(self.n, dtype=jnp.complex128) + D
             # radiation: unit-velocity normal BCs for the 6 modes
             sigma_r = jnp.linalg.solve(lhs, self.modes.T.astype(jnp.complex128))
             phi_r = S @ sigma_r  # [N, 6] potential per unit normal VELOCITY
-            Fr = self.rho * 1j * wi * jnp.einsum("mn,nj,n->mj", self.modes, phi_r, self.jA)
+            # F_mj = -i w rho ∬ phi_j n_m dS ;  F = (i w A - B) v
+            Fr = -1j * wi * self.rho * jnp.einsum("mn,nj,n->mj", self.modes, phi_r, self.jA)
 
             # incident wave potential (unit amplitude, e^{-i k x cos b ...})
             def incident(bh):
@@ -204,13 +208,10 @@ class PanelBEM:
 
         for i in range(nw):
             Fr, X = one_freq(float(w_np[i]), float(k_np[i]))
-            # Fr = i w rho ∬ phi_r n_m dS with phi_r per unit normal
-            # velocity.  With the e^{-i w t} time convention the
-            # decomposition (validated against the Hulme hemisphere
-            # benchmarks) is A = rho Re ∬ phi n, B = +rho w Im ∬ phi n:
-            I_mj = np.asarray(Fr) / (1j * w_np[i] * self.rho)
-            A_out[:, :, i] = self.rho * np.real(I_mj)
-            B_out[:, :, i] = self.rho * w_np[i] * np.imag(I_mj)
+            # F = (i w A - B) v with unit velocity amplitude (e^{-i w t};
+            # validated by the Haskind energy identity in tests/test_bem.py)
+            A_out[:, :, i] = np.imag(np.asarray(Fr)) / w_np[i]
+            B_out[:, :, i] = -np.real(np.asarray(Fr))
             X_out[:, :, i] = np.asarray(X)
 
         return A_out, B_out, X_out
