@@ -133,9 +133,12 @@ class GreenTable:
 
     def _build(self, n_gauss):
         Vg = np.minimum(self.V_grid, -1e-6)  # keep the tail integrable
-        I0 = np.empty((_NA, _NV))
-        for i, a in enumerate(self.A_grid):
-            I0[i, :] = _pv_integral(np.full(_NV, a), Vg, n_gauss=n_gauss)
+        from .. import native
+        I0 = native.pv_table(self.A_grid, Vg, n_gauss=n_gauss)
+        if I0 is None:  # no C++ toolchain: vectorized NumPy fallback
+            I0 = np.empty((_NA, _NV))
+            for i, a in enumerate(self.A_grid):
+                I0[i, :] = _pv_integral(np.full(_NV, a), Vg, n_gauss=n_gauss)
         try:
             os.makedirs(os.path.dirname(self._CACHE), exist_ok=True)
             np.savez_compressed(self._CACHE, A_grid=self.A_grid, V_grid=self.V_grid,

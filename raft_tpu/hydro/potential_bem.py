@@ -33,6 +33,10 @@ import jax.numpy as jnp
 from ..ops import bessel
 from .greens import green_table
 
+# equivalent-square analytic self-integral coefficient:
+# 4*ln(1+sqrt(2)) ~ 3.52549, i.e. int dS/r over a unit square
+SELF_TERM_COEF = 3.52549
+
 
 def _rankine_matrices(centroids, areas, normals):
     """Frequency-independent source influence: S0[i,j] = ∬_j (1/r + 1/r1) dS
@@ -46,6 +50,11 @@ def _rankine_matrices(centroids, areas, normals):
     A = np.asarray(areas)
     Nrm = np.asarray(normals)
     n = len(A)
+
+    from .. import native
+    nat = native.rankine_assemble(C, A, Nrm, SELF_TERM_COEF)
+    if nat is not None:
+        return nat
 
     Ci = C[:, None, :]
     Cj = C[None, :, :]
@@ -62,7 +71,7 @@ def _rankine_matrices(centroids, areas, normals):
     # ~ 3.52549 sqrt(A), while r >> panel size recovers A/r.  This keeps
     # adjacent-panel and near-surface-image integrals (r ~ panel scale,
     # where the bare one-point rule errs by tens of percent) accurate.
-    eps = A[None, :] / 3.52549**2
+    eps = A[None, :] / SELF_TERM_COEF**2
     S0 = A[None, :] / np.sqrt(r**2 + eps) + A[None, :] / np.sqrt(r1**2 + eps)
 
     # gradient wrt field point p=i, desingularized consistently
@@ -71,7 +80,7 @@ def _rankine_matrices(centroids, areas, normals):
     G_direct[idx, idx, :] = 0.0  # flat-panel PV value; the -2*pi jump is added in solve()
     G_image = -d1 / (r1**2 + eps)[..., None] ** 1.5 * A[None, :, None]
     D0 = np.einsum("ijk,ik->ij", G_direct + G_image, Nrm)
-    return S0, D0, r, r1
+    return S0, D0
 
 
 class PanelBEM:
@@ -92,7 +101,7 @@ class PanelBEM:
         self.n = len(self.areas)
         self.ref = np.asarray(ref_point, dtype=float)
 
-        S0, D0, r, r1 = _rankine_matrices(self.centroids, self.areas, self.normals)
+        S0, D0 = _rankine_matrices(self.centroids, self.areas, self.normals)
         self.S0 = jnp.asarray(S0)
         self.D0 = jnp.asarray(D0)
 

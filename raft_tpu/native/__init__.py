@@ -1,0 +1,140 @@
+"""Loader for the native (C++) host engine.
+
+The compute path of this framework is JAX/XLA on TPU; the native layer
+covers the host-side work the reference delegates to Fortran extensions
+(CCBlade ``_bem``, the HAMS executable): principal-value quadrature of
+the free-surface Green function and O(N^2) panel influence assembly.
+
+The shared library is built on demand from ``src/greens.cc`` with g++
+(no pybind11 in this environment — plain C ABI through ctypes) and
+cached under ``~/.cache/raft_tpu`` keyed by a source hash.  Every entry
+point has a NumPy fallback, so the framework works identically (just
+slower on host precompute) when no C++ toolchain is available.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import hashlib
+import os
+import subprocess
+
+import numpy as np
+
+_SRC = os.path.join(os.path.dirname(__file__), "src", "greens.cc")
+_CACHE_DIR = os.path.expanduser("~/.cache/raft_tpu")
+
+_lib = None
+_lib_tried = False
+
+
+def _compile(src: str, out_path: str) -> bool:
+    # build to a tmp path then rename, so an interrupted/concurrent build
+    # can never leave a half-written .so at the cache path
+    tmp_path = f"{out_path}.tmp.{os.getpid()}"
+    cmd = ["g++", "-O3", "-std=c++17", "-shared", "-fPIC", "-pthread",
+           src, "-o", tmp_path]
+    try:
+        r = subprocess.run(cmd, capture_output=True, timeout=240)
+        if r.returncode != 0 or not os.path.exists(tmp_path):
+            return False
+        os.replace(tmp_path, out_path)
+        return True
+    except (OSError, subprocess.TimeoutExpired):
+        return False
+    finally:
+        if os.path.exists(tmp_path):
+            try:
+                os.remove(tmp_path)
+            except OSError:
+                pass
+
+
+def lib():
+    """The loaded native library, or None if unavailable."""
+    global _lib, _lib_tried
+    if _lib_tried:
+        return _lib
+    _lib_tried = True
+    if os.environ.get("RAFT_TPU_NO_NATIVE"):
+        return None
+    try:
+        with open(_SRC, "rb") as f:
+            tag = hashlib.sha256(f.read()).hexdigest()[:16]
+        so_path = os.path.join(_CACHE_DIR, f"libraft_native_{tag}.so")
+        if not os.path.exists(so_path):
+            os.makedirs(_CACHE_DIR, exist_ok=True)
+            if not _compile(_SRC, so_path):
+                return None
+        try:
+            L = ctypes.CDLL(so_path)
+        except OSError:
+            # corrupt cache entry: drop it so the next run rebuilds
+            try:
+                os.remove(so_path)
+            except OSError:
+                pass
+            return None
+        L.raft_native_abi_version.restype = ctypes.c_int
+        if L.raft_native_abi_version() != 2:
+            return None
+        L.raft_rankine_assemble.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_double,
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double)]
+        _lib = L
+    except OSError:
+        _lib = None
+    return _lib
+
+
+def _dptr(a):
+    return a.ctypes.data_as(ctypes.POINTER(ctypes.c_double))
+
+
+def pv_table(A_grid, V_grid, n_gauss=200):
+    """[na, nv] PV-integral table, or None if the native lib is absent."""
+    L = lib()
+    if L is None:
+        return None
+    A = np.ascontiguousarray(A_grid, dtype=np.float64)
+    V = np.ascontiguousarray(V_grid, dtype=np.float64)
+    out = np.empty((len(A), len(V)), dtype=np.float64)
+    L.raft_pv_table(_dptr(A), ctypes.c_int64(len(A)), _dptr(V),
+                    ctypes.c_int64(len(V)), ctypes.c_int(n_gauss), _dptr(out))
+    return out
+
+
+def pv_points(A, V, n_gauss=200):
+    """Elementwise PV integral at arbitrary (A, V), or None."""
+    L = lib()
+    if L is None:
+        return None
+    A, V = np.broadcast_arrays(np.asarray(A, dtype=np.float64),
+                               np.asarray(V, dtype=np.float64))
+    shape = A.shape
+    A = np.ascontiguousarray(A).ravel()
+    V = np.ascontiguousarray(V).ravel()
+    out = np.empty(A.shape, dtype=np.float64)
+    L.raft_pv_points(_dptr(A), _dptr(V), ctypes.c_int64(len(A)),
+                     ctypes.c_int(n_gauss), _dptr(out))
+    return out.reshape(shape)
+
+
+def rankine_assemble(centroids, areas, normals, c_self):
+    """(S0, D0) influence matrices, or None if the native lib is absent.
+
+    ``c_self`` is the equivalent-square self-term coefficient owned by
+    :mod:`raft_tpu.hydro.potential_bem` (single source of truth)."""
+    L = lib()
+    if L is None:
+        return None
+    C = np.ascontiguousarray(centroids, dtype=np.float64)
+    A = np.ascontiguousarray(areas, dtype=np.float64)
+    N = np.ascontiguousarray(normals, dtype=np.float64)
+    n = len(A)
+    S0 = np.empty((n, n), dtype=np.float64)
+    D0 = np.empty((n, n), dtype=np.float64)
+    L.raft_rankine_assemble(_dptr(C), _dptr(A), _dptr(N), ctypes.c_int64(n),
+                            ctypes.c_double(c_self), _dptr(S0), _dptr(D0))
+    return S0, D0

@@ -1,0 +1,190 @@
+// Native host engine for the free-surface Green function and panel
+// influence assembly.
+//
+// This is the C++ counterpart of the Fortran layer the reference
+// framework delegates to (CCBlade's _bem extension and the HAMS panel
+// solver, invoked from raft_fowt.py:623-650): the TPU owns the batched
+// linear algebra, while the irregular, latency-bound host precompute —
+// quadrature of the principal-value wave integral and O(N^2) influence
+// assembly — runs as native multithreaded code.
+//
+// Exposed as a plain C ABI consumed through ctypes (no pybind11 in this
+// environment).  Every routine mirrors its NumPy fallback in
+// raft_tpu/hydro/{greens,potential_bem}.py bit-for-bit in formulation
+// (same Gauss rules, same tail panelization) so the two paths agree to
+// rounding.
+//
+// Build: g++ -O3 -std=c++17 -shared -fPIC -pthread greens.cc -o libraft_native.so
+
+#include <cmath>
+#include <cstdint>
+#include <functional>
+#include <thread>
+#include <vector>
+
+namespace {
+
+// ---------------------------------------------------------------------
+// Gauss-Legendre nodes/weights on [-1, 1] via Newton on P_n.
+void gauss_legendre(int n, std::vector<double>& x, std::vector<double>& w) {
+  x.assign(n, 0.0);
+  w.assign(n, 0.0);
+  const double pi = 3.14159265358979323846;
+  for (int i = 0; i < (n + 1) / 2; ++i) {
+    double xi = std::cos(pi * (i + 0.75) / (n + 0.5));  // Chebyshev guess
+    double pp = 0.0;
+    for (int it = 0; it < 100; ++it) {
+      // evaluate P_n(xi) and P_n'(xi) by recurrence
+      double p0 = 1.0, p1 = xi;
+      for (int k = 2; k <= n; ++k) {
+        double pk = ((2.0 * k - 1.0) * xi * p1 - (k - 1.0) * p0) / k;
+        p0 = p1;
+        p1 = pk;
+      }
+      pp = n * (xi * p1 - p0) / (xi * xi - 1.0);
+      double dx = p1 / pp;
+      xi -= dx;
+      if (std::abs(dx) < 1e-15) break;
+    }
+    x[i] = -xi;
+    x[n - 1 - i] = xi;
+    w[i] = 2.0 / ((1.0 - xi * xi) * pp * pp);
+    w[n - 1 - i] = w[i];
+  }
+}
+
+inline double bessel_j0(double x) { return ::j0(x); }   // POSIX libm
+inline double bessel_j1(double x) { return ::j1(x); }
+
+// ---------------------------------------------------------------------
+// PV integral  I(A, V) = PV \int_0^inf e^{Vt} J0(At) / (t - 1) dt
+// for V < 0, A >= 0, by singularity subtraction on [0, 2] plus an
+// oscillation-aware composite-Gauss tail — the same rule as
+// raft_tpu.hydro.greens._pv_integral.
+struct PvRule {
+  std::vector<double> x200, w200, x8, w8;
+  int n_gauss;
+  explicit PvRule(int n) : n_gauss(n) {
+    gauss_legendre(n, x200, w200);
+    gauss_legendre(8, x8, w8);
+  }
+};
+
+double pv_single(double A, double V, const PvRule& rule) {
+  if (V > -1e-8) V = -1e-8;
+
+  // regularized part on [0, 2]
+  const double f_at_1 = std::exp(V) * bessel_j0(A);
+  double part1 = 0.0;
+  for (int g = 0; g < rule.n_gauss; ++g) {
+    const double t = (rule.x200[g] + 1.0);  // [0, 2]
+    const double f = std::exp(V * t) * bessel_j0(A * t);
+    if (std::abs(t - 1.0) > 1e-12) part1 += rule.w200[g] * (f - f_at_1) / (t - 1.0);
+  }
+  // (dt/dxi = 1 for the [0,2] map)
+
+  // oscillation-aware tail from 2 to T
+  const double V_slow = std::min(V, -1e-6);
+  const double T_decay = std::max(10.0, 40.0 / std::max(-V_slow, 0.15));
+  const double T_osc = std::max(10.0, 600.0 / std::max(A, 1.0));
+  double T = 2.0 + std::min(T_decay, T_osc);
+  if (T > 400.0) T = 400.0;
+  const double panel_len = std::min(1.0, M_PI / (2.0 * std::max(A, 1e-6) + 1.0));
+  const int n_panels = (int)std::ceil((T - 2.0) / panel_len);
+  const double h = (T - 2.0) / n_panels;
+  double part2 = 0.0;
+  for (int p = 0; p < n_panels; ++p) {
+    const double lo = 2.0 + p * h;
+    const double mid = lo + 0.5 * h, half = 0.5 * h;
+    for (int g = 0; g < 8; ++g) {
+      const double t = mid + half * rule.x8[g];
+      part2 += half * rule.w8[g] * std::exp(V * t) * bessel_j0(A * t) / (t - 1.0);
+    }
+  }
+  return part1 + part2;
+}
+
+void parallel_for(int64_t n, const std::function<void(int64_t, int64_t)>& body) {
+  unsigned hw = std::thread::hardware_concurrency();
+  int nt = hw ? (int)hw : 4;
+  if (n < nt) nt = (int)n;
+  if (nt <= 1) {
+    body(0, n);
+    return;
+  }
+  std::vector<std::thread> threads;
+  const int64_t chunk = (n + nt - 1) / nt;
+  for (int t = 0; t < nt; ++t) {
+    int64_t lo = t * chunk, hi = std::min<int64_t>(n, lo + chunk);
+    if (lo >= hi) break;
+    threads.emplace_back([=, &body] { body(lo, hi); });
+  }
+  for (auto& th : threads) th.join();
+}
+
+}  // namespace
+
+extern "C" {
+
+// out[na * nv]: I(A_grid[i], V_grid[j]) row-major, parallel over rows.
+void raft_pv_table(const double* A_grid, int64_t na, const double* V_grid,
+                   int64_t nv, int n_gauss, double* out) {
+  PvRule rule(n_gauss);
+  parallel_for(na, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i)
+      for (int64_t j = 0; j < nv; ++j)
+        out[i * nv + j] = pv_single(A_grid[i], V_grid[j], rule);
+  });
+}
+
+// Arbitrary-point PV evaluation (used by tests / rigorous solver).
+void raft_pv_points(const double* A, const double* V, int64_t n, int n_gauss,
+                    double* out) {
+  PvRule rule(n_gauss);
+  parallel_for(n, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) out[i] = pv_single(A[i], V[i], rule);
+  });
+}
+
+// Desingularized Rankine + free-surface-image influence matrices, the
+// same rule as potential_bem._rankine_matrices:
+//   S0[i,j] = A_j / sqrt(r^2 + eps_j) + A_j / sqrt(r1^2 + eps_j)
+//   D0[i,j] = n_i . (grad_p of both terms), self direct term zeroed.
+// centroids[n*3], areas[n], normals[n*3]; S0, D0 are [n*n] row-major.
+// c_self is passed in from Python (potential_bem.SELF_TERM_COEF) so the
+// native and NumPy paths share one source of truth; parity is pinned by
+// tests/test_native.py::test_rankine_assembly_matches_numpy.
+void raft_rankine_assemble(const double* centroids, const double* areas,
+                           const double* normals, int64_t n, double c_self,
+                           double* S0, double* D0) {
+  parallel_for(n, [&](int64_t lo, int64_t hi) {
+    for (int64_t i = lo; i < hi; ++i) {
+      const double xi = centroids[3 * i], yi = centroids[3 * i + 1],
+                   zi = centroids[3 * i + 2];
+      const double nx = normals[3 * i], ny = normals[3 * i + 1],
+                   nz = normals[3 * i + 2];
+      for (int64_t j = 0; j < n; ++j) {
+        const double xj = centroids[3 * j], yj = centroids[3 * j + 1],
+                     zj = centroids[3 * j + 2];
+        const double Aj = areas[j];
+        const double eps = Aj / (c_self * c_self);
+        const double dx = xi - xj, dy = yi - yj;
+        const double dz = zi - zj, dz1 = zi + zj;  // image: z_j -> -z_j
+        const double r2 = dx * dx + dy * dy + dz * dz;
+        const double r12 = dx * dx + dy * dy + dz1 * dz1;
+        S0[i * n + j] = Aj / std::sqrt(r2 + eps) + Aj / std::sqrt(r12 + eps);
+        const double g3 = std::pow(r2 + eps, -1.5) * Aj;
+        const double g3i = std::pow(r12 + eps, -1.5) * Aj;
+        double d = 0.0;
+        if (i != j)  // self direct term carries only the -2*pi jump
+          d += -(dx * nx + dy * ny + dz * nz) * g3;
+        d += -(dx * nx + dy * ny + dz1 * nz) * g3i;
+        D0[i * n + j] = d;
+      }
+    }
+  });
+}
+
+int raft_native_abi_version() { return 2; }
+
+}  // extern "C"
