@@ -13,10 +13,10 @@ from .schema import get_from_dict  # noqa: F401
 def __getattr__(name):
     # Lazy import so that `import raft_tpu` stays cheap and so ops-level
     # test environments don't pay for the full model stack.
-    if name == "Model":
+    if name in ("Model", "runRAFT", "runRAFTFarm"):
         try:
-            from .core.model import Model
+            from .core import model as _model
         except ImportError as e:
-            raise AttributeError(f"raft_tpu.Model unavailable: {e}") from e
-        return Model
+            raise AttributeError(f"raft_tpu.{name} unavailable: {e}") from e
+        return getattr(_model, name)
     raise AttributeError(name)

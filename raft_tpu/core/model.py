@@ -706,4 +706,20 @@ def runRAFT(input_file, turbine_file="", plot=0, ballast=False):
     model.analyzeUnloaded(ballast=ballast)
     model.analyzeCases(display=1)
     model.calcOutputs()
+    if plot:
+        model.plot()
+        model.plotResponses()
+    return model
+
+
+def runRAFTFarm(input_file, plot=0):
+    """Multi-turbine array driver (raft_model.py:2065-2096): skips the
+    unloaded equilibrium/ballast pass and the single-turbine calcOutputs,
+    both unsupported for farms in the reference too."""
+    design = load_design(input_file)
+    model = Model(design)
+    model.analyzeCases(display=1)
+    if plot:
+        model.plot()
+        model.plotResponses()
     return model

@@ -54,28 +54,110 @@ def assemble_design(inputs, discrete_inputs, modeling_opts, turbine_opts,
     nmembers = member_opts.get("nmembers", 0)
     for i in range(nmembers):
         pre = f"platform_member{i+1}_"
+
+        # ghost-segment trimming (omdao_raft.py:518-528): WEIS passes the
+        # full joint-to-joint axis plus the [s_ghostA, s_ghostB] sub-range
+        # that is physically present; stations/profiles are re-gridded to it
+        s_0 = np.atleast_1d(np.asarray(inputs[pre + "stations"], dtype=float))
+        rA_0 = np.asarray(inputs[pre + "rA"], dtype=float)
+        rB_0 = np.asarray(inputs[pre + "rB"], dtype=float)
+        ghosts = (pre + "s_ghostA" in inputs) or (pre + "s_ghostB" in inputs)
+        if ghosts:
+            # WEIS normalizes stations to [0, 1] along rA->rB when it
+            # supplies ghost ranges; only then is endpoint shifting valid
+            s_gA = float(np.ravel(inputs.get(pre + "s_ghostA", [0.0]))[0])
+            s_gB = float(np.ravel(inputs.get(pre + "s_ghostB", [1.0]))[0])
+            idx = np.logical_and(s_0 >= s_gA, s_0 <= s_gB)
+            s_grid = np.unique(np.r_[s_gA, s_0[idx], s_gB])
+            rA = rA_0 + s_gA * (rB_0 - rA_0)
+            rB = rA_0 + s_gB * (rB_0 - rA_0)
+        else:
+            s_gA, s_gB = s_0[0], s_0[-1]
+            s_grid = s_0
+            rA, rB = rA_0, rB_0
+
+        def regrid(key, default=None):
+            v = inputs.get(pre + key, default)
+            if v is None:
+                return None
+            v = np.atleast_1d(np.asarray(v, dtype=float))
+            if v.size == 1:
+                return np.full(len(s_grid), v[0])
+            return np.interp(s_grid, s_0, v)
+
         mem = {
             "name": f"member{i+1}",
+            # always type 2 (platform member): this codebase reserves
+            # type 3 for blade members (structure/member.py waterplane-
+            # check exemption), unlike the reference's cosmetic i+2
             "type": 2,
-            "rA": np.asarray(inputs[pre + "rA"]).tolist(),
-            "rB": np.asarray(inputs[pre + "rB"]).tolist(),
+            "rA": rA.tolist(),
+            "rB": rB.tolist(),
             "shape": member_opts.get("shapes", ["circ"] * nmembers)[i],
             "gamma": float(np.ravel(inputs.get(pre + "gamma", [0.0]))[0]),
-            "stations": np.asarray(inputs[pre + "stations"]).tolist(),
-            "d": np.asarray(inputs[pre + "d"]).tolist(),
-            "t": np.asarray(inputs[pre + "t"]).tolist(),
+            "stations": s_grid.tolist(),
+            "d": regrid("d").tolist(),
+            "t": regrid("t").tolist(),
             "Cd": float(np.ravel(inputs.get(pre + "Cd", [0.6]))[0]),
             "Ca": float(np.ravel(inputs.get(pre + "Ca", [1.0]))[0]),
             "CdEnd": float(np.ravel(inputs.get(pre + "CdEnd", [0.6]))[0]),
             "CaEnd": float(np.ravel(inputs.get(pre + "CaEnd", [1.0]))[0]),
             "rho_shell": float(np.ravel(inputs.get(pre + "rho_shell", [7850.0]))[0]),
         }
-        for opt in ("l_fill", "rho_fill", "potMod", "heading", "cap_stations",
-                    "cap_t", "cap_d_in"):
+        for opt in ("l_fill", "rho_fill", "potMod", "heading"):
             key = pre + opt
             if key in inputs:
                 v = np.asarray(inputs[key])
                 mem[opt] = v.tolist() if v.ndim else v.item()
+
+        # bulkheads/end caps + ring stiffeners as equivalent caps
+        # (omdao_raft.py:598-635): caps outside the ghost range are
+        # dropped, no caps at trimmed joints, rings at half-spacing
+        # offsets with inner diameter d - 2*ring_h
+        ring_spacing = float(np.ravel(inputs.get(pre + "ring_spacing", [0.0]))[0])
+        s_cap_0 = np.atleast_1d(np.asarray(
+            inputs.get(pre + "cap_stations", []), dtype=float))
+        if len(s_cap_0) > 0 or ring_spacing > 0:
+            s_height = s_grid[-1] - s_grid[0]
+            n_stiff = 0 if ring_spacing == 0.0 else int(np.floor(s_height / ring_spacing))
+            # half-spacing offsets anchored at the (possibly ghost-trimmed)
+            # member start — the reference anchors at 0, which places rings
+            # outside a trimmed member (omdao_raft.py:602); fixed here
+            s_ring = s_grid[0] + (np.arange(1, n_stiff + 0.1) - 0.5) * ring_spacing
+            if len(s_cap_0) > 0:
+                cap_t_0 = np.atleast_1d(np.asarray(inputs[pre + "cap_t"], dtype=float))
+                cap_di_0 = np.atleast_1d(np.asarray(
+                    inputs.get(pre + "cap_d_in", np.zeros_like(s_cap_0)), dtype=float))
+                idx_cap = np.logical_and(s_cap_0 >= s_gA, s_cap_0 <= s_gB)
+                s_cap, isort = np.unique(np.r_[s_gA, s_cap_0[idx_cap], s_gB],
+                                         return_index=True)
+                t_cap = np.r_[cap_t_0[0], cap_t_0[idx_cap], cap_t_0[-1]][isort]
+                di_cap = np.r_[cap_di_0[0], cap_di_0[idx_cap], cap_di_0[-1]][isort]
+                if ghosts and s_gA > 0.0:  # no end caps at trimmed joints
+                    s_cap, t_cap, di_cap = s_cap[1:], t_cap[1:], di_cap[1:]
+                if ghosts and s_gB < 1.0:
+                    s_cap, t_cap, di_cap = s_cap[:-1], t_cap[:-1], di_cap[:-1]
+            else:
+                s_cap = np.array([])
+                t_cap = np.array([])
+                di_cap = np.array([])
+            if len(s_ring) > 0:
+                # rings coinciding with an explicit cap would create a
+                # duplicate station the member compiler reads as a
+                # discontinuity pair; the explicit cap wins
+                fresh = ~np.isin(np.round(s_ring, 9), np.round(s_cap, 9))
+                s_ring = s_ring[fresh]
+                d_ring = np.interp(s_ring, s_grid, np.asarray(mem["d"]))
+                ring_t = float(np.ravel(inputs.get(pre + "ring_t", [0.0]))[0])
+                ring_h = float(np.ravel(inputs.get(pre + "ring_h", [0.0]))[0])
+                s_cap = np.r_[s_ring, s_cap]
+                t_cap = np.r_[ring_t * np.ones(len(s_ring)), t_cap]
+                di_cap = np.r_[d_ring - 2 * ring_h, di_cap]
+            if len(s_cap) > 0:
+                isort = np.argsort(s_cap)
+                mem["cap_stations"] = s_cap[isort].tolist()
+                mem["cap_t"] = t_cap[isort].tolist()
+                mem["cap_d_in"] = di_cap[isort].tolist()
         design["platform"]["members"].append(mem)
 
     # mooring section (points/lines/line_types from flat arrays)
@@ -117,58 +199,144 @@ def assemble_design(inputs, discrete_inputs, modeling_opts, turbine_opts,
     return design
 
 
-def extract_outputs(model, outputs):
+STATS_NAMES = ("surge", "sway", "heave", "roll", "pitch", "yaw",
+               "AxRNA", "Mbase", "Tmoor")
+STATS_KINDS = ("avg", "std", "max", "PSD")
+
+
+def extract_outputs(model, outputs, rated_rotor_speed=None):
     """Map model results into the reference's output names
-    (omdao_raft.py:748-810)."""
+    (omdao_raft.py:748-810): pattern-matched ``properties_*``, per-case
+    ``stats_{channel}_{stat}`` arrays, natural periods, WEIS aggregate
+    constraints, and the combined platform_* outputs for OpenFAST."""
     results = model.results
     fowt = model.fowtList[0]
-    props = results.get("properties", {})
-    outputs["properties_substructure mass"] = props.get("substructure mass", fowt.m_sub)
-    outputs["properties_total mass"] = props.get("total mass", fowt.M_struc[0, 0])
-    outputs["properties_buoyancy (pgV)"] = props.get(
-        "buoyancy (pgV)", fowt.rho_water * fowt.g * fowt.V)
 
-    if "eigen" in results:
-        fns = np.asarray(results["eigen"]["frequencies"]).real
-        outputs["rigid_body_periods"] = 1.0 / np.maximum(fns, 1e-9)
+    for name, val in results.get("properties", {}).items():
+        outputs[f"properties_{name}"] = np.asarray(val)
 
     cm = results.get("case_metrics", {})
     if cm:
-        max_surge, max_pitch, max_axrna = 0.0, 0.0, 0.0
-        for iCase in cm:
-            m = cm[iCase][0]
-            max_surge = max(max_surge, abs(m["surge_max"]), abs(m["surge_min"]))
-            max_pitch = max(max_pitch, abs(m["pitch_max"]), abs(m["pitch_min"]))
-            max_axrna = max(max_axrna, float(np.max(m["AxRNA_max"])))
-            for key in ("surge_avg", "surge_std", "pitch_avg", "pitch_std",
-                        "heave_avg", "heave_std", "yaw_avg", "yaw_std"):
-                outputs[f"stats_{key}_case{iCase}"] = m[key]
-        # WEIS aggregate constraints (omdao_raft.py:794-810)
-        outputs["Max_Offset"] = max_surge
-        outputs["Max_PtfmPitch"] = max_pitch
-        outputs["max_nac_accel"] = max_axrna
+        # first FOWT per case, like the reference (omdao_raft.py:776-779)
+        case_metrics = [cm[i][0] for i in sorted(cm)]
+        for n in STATS_NAMES + ("omega", "torque", "power", "bPitch"):
+            for s in STATS_KINDS:
+                iout = f"{n}_{s}"
+                if iout not in case_metrics[0]:
+                    continue
+                outputs["stats_" + iout] = np.squeeze(
+                    np.array([np.asarray(m[iout], dtype=float)
+                              for m in case_metrics]))
+        for n in ("wind_PSD", "wave_PSD"):
+            if n in case_metrics[0]:
+                outputs["stats_" + n] = np.array(
+                    [np.asarray(m[n], dtype=float) for m in case_metrics])
+
+    if "eigen" in results:
+        fns = np.asarray(results["eigen"]["frequencies"]).real
+        periods = 1.0 / np.maximum(fns, 1e-9)
+        outputs["rigid_body_periods"] = periods
+        for idof, dof in enumerate(("surge", "sway", "heave",
+                                    "roll", "pitch", "yaw")):
+            if idof < len(periods):
+                outputs[f"{dof}_period"] = periods[idof]
+
+    # WEIS aggregate constraints (omdao_raft.py:794-806)
+    if cm:
+        def stat(name):
+            return np.atleast_1d(outputs.get("stats_" + name, np.zeros(1)))
+
+        outputs["Max_Offset"] = float(
+            np.sqrt(stat("surge_max") ** 2 + stat("sway_max") ** 2).max())
+        outputs["heave_avg"] = float(stat("heave_avg").mean())
+        outputs["Max_PtfmPitch"] = float(stat("pitch_max").max())
+        outputs["Std_PtfmPitch"] = float(stat("pitch_std").mean())
+        outputs["max_nac_accel"] = float(stat("AxRNA_std").max())
+        outputs["max_tower_base"] = float(stat("Mbase_max").max())
+        if rated_rotor_speed and "stats_omega_max" in outputs:
+            outputs["rotor_overspeed"] = float(
+                (stat("omega_max").max() - rated_rotor_speed) / rated_rotor_speed)
+
+    # combined outputs for OpenFAST (omdao_raft.py:805-811)
+    outputs["platform_displacement"] = float(fowt.V)
+    props = results.get("properties", {})
+    if "substructure CG" in props:
+        outputs["platform_total_center_of_mass"] = np.asarray(props["substructure CG"])
+        outputs["platform_mass"] = float(np.asarray(props["substructure mass"]))
+        I_total = np.zeros(6)  # first 3 filled, like the reference (:810)
+        I_total[:3] = [np.atleast_1d(props["roll inertia at subCG"])[0],
+                       np.atleast_1d(props["pitch inertia at subCG"])[0],
+                       np.atleast_1d(props["yaw inertia at subCG"])[0]]
+        outputs["platform_I_total"] = I_total
     return outputs
 
 
-def run_raft_omdao(inputs, discrete_inputs, options):
+def filter_dlc_cases(keys, data):
+    """Keep only the spectral-wind DLCs RAFT supports — NTM/ETM/EWM
+    turbulence entries (omdao_raft.py:676-686)."""
+    if "turbulence" not in keys:
+        return list(data), [True] * len(data)
+    it = keys.index("turbulence")
+
+    def ok(v):
+        if isinstance(v, str):
+            try:
+                float(v)
+            except ValueError:
+                # WEIS-style DLC label: spectral models only
+                return any(t in v for t in ("NTM", "ETM", "EWM"))
+        return True  # numeric turbulence intensity is always spectral
+
+    mask = [ok(row[it]) for row in data]
+    return [row for row, m in zip(data, mask) if m], mask
+
+
+def run_raft_omdao(inputs, discrete_inputs, options, i_design=0):
     """Headless compute(): assemble → analyze → extract
     (the body of RAFT_OMDAO.compute, omdao_raft.py:698-810)."""
+    modeling = options.get("modeling_options", {})
     design = assemble_design(
         inputs, discrete_inputs,
-        options.get("modeling_options", {}),
+        modeling,
         options.get("turbine_options", {}),
         options.get("mooring_options", {}),
         options.get("member_options", {}),
         options.get("analysis_options", {}),
     )
+    design["cases"]["data"], _ = filter_dlc_cases(
+        design["cases"].get("keys", []), design["cases"].get("data", []))
+
+    if modeling.get("save_designs"):
+        # design-checkpoint hook (omdao_raft.py:689-696): every evaluated
+        # design round-trips through pickle + YAML for resume/debug
+        import os
+        import pickle
+
+        import yaml
+
+        out_dir = os.path.join(
+            options.get("analysis_options", {}).get("general", {})
+            .get("folder_output", "."), "raft_designs")
+        os.makedirs(out_dir, exist_ok=True)
+        base = os.path.join(out_dir, f"raft_design_{i_design}")
+        with open(base + ".pkl", "wb") as fh:
+            pickle.dump(design, fh, protocol=pickle.HIGHEST_PROTOCOL)
+        from .io_utils import clean_raft_dict
+        with open(base + ".yaml", "w") as fh:
+            yaml.safe_dump(clean_raft_dict(design), fh, sort_keys=False)
+
     model = Model(design)
-    model.analyzeUnloaded()
+    model.analyzeUnloaded(
+        ballast=modeling.get("trim_ballast", 0),
+        heave_tol=modeling.get("heave_tol", 1.0))
     if design["cases"]["data"]:
         model.analyzeCases()
     model.calcOutputs()
     model.solveEigen()
     outputs = {}
-    extract_outputs(model, outputs)
+    rated = inputs.get("rated_rotor_speed")
+    extract_outputs(model, outputs,
+                    rated_rotor_speed=float(np.ravel(rated)[0]) if rated is not None else None)
     return model, outputs
 
 
@@ -184,6 +352,7 @@ if HAVE_OM:
             self.options.declare("mooring_options")
             self.options.declare("member_options")
             self.options.declare("analysis_options")
+            self.i_design = 0  # save_designs checkpoint counter
 
         def setup(self):
             mem_opts = self.options["member_options"] or {}
@@ -194,6 +363,7 @@ if HAVE_OM:
             self.add_input("mooring_water_depth", val=200.0, units="m")
             self.add_input("rho_water", val=1025.0, units="kg/m**3")
             self.add_input("rho_air", val=1.225, units="kg/m**3")
+            self.add_input("rated_rotor_speed", val=0.0, units="rpm")
 
             for i in range(nmem):
                 pre = f"platform_member{i+1}_"
@@ -211,6 +381,17 @@ if HAVE_OM:
                 self.add_input(pre + "rho_shell", val=7850.0, units="kg/m**3")
                 self.add_input(pre + "l_fill", val=np.zeros(max(n - 1, 1)), units="m")
                 self.add_input(pre + "rho_fill", val=np.zeros(max(n - 1, 1)), units="kg/m**3")
+                self.add_input(pre + "s_ghostA", val=0.0)
+                self.add_input(pre + "s_ghostB", val=1.0)
+                self.add_input(pre + "ring_spacing", val=0.0)
+                self.add_input(pre + "ring_t", val=0.0, units="m")
+                self.add_input(pre + "ring_h", val=0.0, units="m")
+                ncaps = int(mem_opts.get("ncaps", [0] * nmem)[i]) \
+                    if i < len(mem_opts.get("ncaps", [])) else 0
+                if ncaps:
+                    self.add_input(pre + "cap_stations", val=np.zeros(ncaps))
+                    self.add_input(pre + "cap_t", val=np.zeros(ncaps), units="m")
+                    self.add_input(pre + "cap_d_in", val=np.zeros(ncaps), units="m")
 
             nlines = int(moor_opts.get("nlines", 0))
             npts = int(moor_opts.get("npoints", 2 * nlines))
@@ -231,11 +412,21 @@ if HAVE_OM:
                 self.add_input(pre + "stiffness", val=1e8)
                 self.add_discrete_input(pre + "name", val="chain")
 
-            # aggregate outputs WEIS consumes
+            # aggregate outputs WEIS consumes (omdao_raft.py:794-811)
             self.add_output("Max_Offset", val=0.0, units="m")
+            self.add_output("heave_avg", val=0.0, units="m")
             self.add_output("Max_PtfmPitch", val=0.0, units="deg")
+            self.add_output("Std_PtfmPitch", val=0.0, units="deg")
             self.add_output("max_nac_accel", val=0.0, units="m/s**2")
+            self.add_output("rotor_overspeed", val=0.0)
+            self.add_output("max_tower_base", val=0.0, units="N*m")
             self.add_output("rigid_body_periods", val=np.zeros(6), units="s")
+            for dof in ("surge", "sway", "heave", "roll", "pitch", "yaw"):
+                self.add_output(f"{dof}_period", val=0.0, units="s")
+            self.add_output("platform_displacement", val=0.0, units="m**3")
+            self.add_output("platform_total_center_of_mass", val=np.zeros(3), units="m")
+            self.add_output("platform_mass", val=0.0, units="kg")
+            self.add_output("platform_I_total", val=np.zeros(6), units="kg*m**2")
 
         def compute(self, inputs, outputs, discrete_inputs=None, discrete_outputs=None):
             opts = {k: self.options[k] for k in
@@ -243,7 +434,8 @@ if HAVE_OM:
                      "member_options", "analysis_options")}
             ins = {k: np.asarray(v) for k, v in dict(inputs).items()}
             dins = dict(discrete_inputs) if discrete_inputs is not None else {}
-            _, out = run_raft_omdao(ins, dins, opts)
+            _, out = run_raft_omdao(ins, dins, opts, i_design=self.i_design)
+            self.i_design += 1
             for k, v in out.items():
                 if k in outputs:
                     outputs[k] = v
