@@ -452,8 +452,15 @@ class Model:
                 moorsys.array_coupled_stiffness(self.ms, self._fowt_positions())
             )[:, :, None]
 
-        # batched inverse over ω
-        Zinv = np.asarray(jnp.linalg.inv(jnp.asarray(np.moveaxis(Z_sys, 2, 0))))  # [nw,d,d]
+        # batched inverse over ω (fused batch-last Gauss-Jordan; unrolled
+        # in the DOF count, so fall back to LU for very large arrays)
+        from ..parallel import smallsolve
+
+        Z_T = jnp.asarray(np.moveaxis(Z_sys, 2, 0))  # [nw,d,d]
+        if self.nDOF <= 24:
+            Zinv = np.asarray(smallsolve.inverse_impedance(Z_T))
+        else:
+            Zinv = np.asarray(jnp.linalg.inv(Z_T))
 
         nWaves = self.fowtList[0].nWaves
         self.Xi = np.zeros([nWaves + 1, self.nDOF, self.nw], dtype=complex)

@@ -92,96 +92,120 @@ def _member_qtf(topo, geom, pose, w2nd, k2nd, beta, depth, Xi, rho, g):
     i2 = jnp.arange(nw2)[None, :]
     tri = (i2 >= i1)  # upper triangle incl. diagonal
 
-    w1g = w2nd[:, None, None]  # [nw2,1,1] broadcast over (i2, node)
     w2g = w2nd[None, :, None]
-    k1g = k2nd[:, None, None]
     k2g = k2nd[None, :, None]
-
-    # ----- second-order potential: acc [nw2,nw2,N,3], pressure [nw2,nw2,N]
-    acc_2p, p_2nd = waves2.pot2nd(w1g, w2g, k1g, k2g, beta, depth, r[None, None, :, :],
-                                  g=g, rho=rho)
 
     # symmetrization rule throughout:
     # X(i1,i2) = 0.25*( A(i1) op conj(B(i2)) + conj(A(i2)) op B(i1) )
-
-    # convective acceleration [nw2,nw2,N,3]
-    conv = 0.25 * (
-        jnp.einsum("anij,bnj->abni", gu, jnp.conj(u_n))
-        + jnp.einsum("anij,bnj->bani", jnp.conj(gu), u_n)
-    )
-
-    # nabla (body motion in first-order field)
-    nab = 0.25 * (
-        jnp.einsum("anij,bnj->abni", gdudt, jnp.conj(dr_n))
-        + jnp.einsum("anij,bnj->bani", jnp.conj(gdudt), dr_n)
-    )
-
-    # axial divergence (Rainey): dwdz_i = q.grad_u(i).q
     dwdz = jnp.einsum("i,wnij,j->wn", q, gu, q)  # [nw2,N]
     u_rel_perp = u_rel - jnp.einsum("ij,wnj->wni", qM, u_rel)
-    axdv = 0.25 * (
-        dwdz[:, None, :, None] * jnp.conj(u_rel_perp)[None, :, :, :]
-        + jnp.conj(dwdz)[None, :, :, None] * u_rel_perp[:, None, :, :]
-    )
-    axdv = axdv - jnp.einsum("ij,abnj->abni", qM, axdv)
-
-    # Rainey slender-body rotation term:
-    # -0.25*2 * PmatCa @ (OMEGA1 (conj(vax2) q) + conj(OMEGA2) (vax1 q))
     om_q = jnp.einsum("wij,j->wi", OMEGA, q)  # [nw2,3] (OMEGA @ q)
-    rslb = -0.5 * (
-        om_q[:, None, None, :] * jnp.conj(vax)[None, :, :, None]
-        + jnp.conj(om_q)[None, :, None, :] * vax[:, None, :, None]
-    )
-    rslb = jnp.einsum("nij,abnj->abni", PmatCa, rslb)
-
     Pu_rel = jnp.einsum("nij,wnj->wni", PmatCa, u_rel)
-    t1 = 0.25 * (
-        jnp.einsum("anij,bnj->abni", Vmat, jnp.conj(Pu_rel))
-        + jnp.einsum("anij,bnj->bani", jnp.conj(Vmat), Pu_rel)
-    )
-    t1 = t1 - jnp.einsum("ij,abnj->abni", qM, t1)
+    P12u = jnp.einsum("ij,wnj->wni", p1M + p2M, u_rel)
 
-    Vu_perp = jnp.einsum("anij,bnj->abni", Vmat, jnp.conj(u_rel_perp))
-    Vu_perp2 = jnp.einsum("anij,bnj->bani", jnp.conj(Vmat), u_rel_perp)
-    t2 = 0.25 * jnp.einsum("nij,abnj->abni", PmatCa, Vu_perp + Vu_perp2)
-
-    # ----- assemble per-node 3-D forces on the pair grid -----
     vi_w = (v_i * wet)[None, None, :, None]
     vend_w = (v_end * wet)[None, None, :, None]
     ai_w = (a_i * wet)[None, None, :]
 
-    f_2ndPot = rho * vi_w * jnp.einsum("nij,abnj->abni", Pmat1, acc_2p)
-    f_2ndPot = f_2ndPot + ai_w[..., None] * p_2nd[..., None] * q[None, None, None, :]
-    f_2ndPot = f_2ndPot + rho * vend_w * Ca_End[None, None, :, None] * jnp.einsum(
-        "ij,abnj->abni", qM, acc_2p)
+    def pair_rows(a_idx):
+        """Force rollup for a block of w1 rows: [blk, nw2, 6].
 
-    f_conv = rho * vi_w * jnp.einsum("nij,abnj->abni", Pmat1, conv)
-    f_conv = f_conv + rho * vend_w * Ca_End[None, None, :, None] * jnp.einsum(
-        "ij,abnj->abni", qM, conv)
-    # pressure-drop end term (reference applies no (i1,i2) symmetrization:
-    # p_drop = -0.25*rho*dot(P12 u1rel, conj(PmatCa u2rel)), raft_fowt.py:1593)
-    P12u = jnp.einsum("ij,wnj->wni", p1M + p2M, u_rel)
-    p_drop = -2 * 0.25 * 0.5 * rho * jnp.einsum("ani,bni->abn", P12u, jnp.conj(Pu_rel))
-    f_conv = f_conv + ai_w[..., None] * p_drop[..., None] * q[None, None, None, :]
+        The (w1, w2) plane is evaluated in row blocks so the per-node
+        pair tensors stay O(blk * nw2 * N) instead of O(nw2^2 * N) —
+        the blockwise tiling of the framework's "sequence" axis
+        (SURVEY.md §5); each block is one fused tensor expression.
+        """
+        take = lambda x: jnp.take(x, a_idx, axis=0)
+        gu_a, gdudt_a = take(gu), take(gdudt)
+        u_a, dr_a = take(u_n), take(dr_n)
+        urelp_a = take(u_rel_perp)
+        vax_a, dwdz_a = take(vax), take(dwdz)
+        omq_a = take(om_q)
+        Vmat_a = take(Vmat)
+        Pu_a = take(Pu_rel)
+        P12u_a = take(P12u)
+        gpres_a = take(gpres)
 
-    f_axdv = rho * vi_w * jnp.einsum("nij,abnj->abni", PmatCa, axdv)
+        w1g = w2nd[a_idx][:, None, None]  # [blk,1,1]
+        k1g = k2nd[a_idx][:, None, None]
 
-    f_nabla = rho * vi_w * jnp.einsum("nij,abnj->abni", Pmat1, nab)
-    f_nabla = f_nabla + rho * vend_w * Ca_End[None, None, :, None] * jnp.einsum(
-        "ij,abnj->abni", qM, nab)
-    p_nabla = 0.25 * (
-        jnp.einsum("ani,bni->abn", gpres, jnp.conj(dr_n))
-        + jnp.einsum("ani,bni->ban", jnp.conj(gpres), dr_n)
-    )
-    f_nabla = f_nabla + ai_w[..., None] * p_nabla[..., None] * q[None, None, None, :]
+        # second-order potential: acc [blk,nw2,N,3], pressure [blk,nw2,N]
+        acc_2p, p_2nd = waves2.pot2nd(w1g, w2g, k1g, k2g, beta, depth,
+                                      r[None, None, :, :], g=g, rho=rho)
 
-    f_rslb = rho * vi_w * (rslb + t1 - t2)
+        # convective acceleration [blk,nw2,N,3]
+        conv = 0.25 * (
+            jnp.einsum("anij,bnj->abni", gu_a, jnp.conj(u_n))
+            + jnp.einsum("anij,bnj->bani", jnp.conj(gu), u_a)
+        )
 
-    f_all = f_2ndPot + f_conv + f_axdv + f_nabla + f_rslb  # [nw2,nw2,N,3]
+        # nabla (body motion in first-order field)
+        nab = 0.25 * (
+            jnp.einsum("anij,bnj->abni", gdudt_a, jnp.conj(dr_n))
+            + jnp.einsum("anij,bnj->bani", jnp.conj(gdudt), dr_a)
+        )
 
-    # 6-DOF rollup about the origin (reference translates by mem.r)
-    F6 = transforms.translate_force_3to6(f_all, r[None, None, :, :])  # [nw2,nw2,N,6]
-    Q = jnp.sum(F6, axis=2)
+        # axial divergence (Rainey)
+        axdv = 0.25 * (
+            dwdz_a[:, None, :, None] * jnp.conj(u_rel_perp)[None, :, :, :]
+            + jnp.conj(dwdz)[None, :, :, None] * urelp_a[:, None, :, :]
+        )
+        axdv = axdv - jnp.einsum("ij,abnj->abni", qM, axdv)
+
+        # Rainey slender-body rotation term
+        rslb = -0.5 * (
+            omq_a[:, None, None, :] * jnp.conj(vax)[None, :, :, None]
+            + jnp.conj(om_q)[None, :, None, :] * vax_a[:, None, :, None]
+        )
+        rslb = jnp.einsum("nij,abnj->abni", PmatCa, rslb)
+
+        t1 = 0.25 * (
+            jnp.einsum("anij,bnj->abni", Vmat_a, jnp.conj(Pu_rel))
+            + jnp.einsum("anij,bnj->bani", jnp.conj(Vmat), Pu_a)
+        )
+        t1 = t1 - jnp.einsum("ij,abnj->abni", qM, t1)
+
+        Vu_perp = jnp.einsum("anij,bnj->abni", Vmat_a, jnp.conj(u_rel_perp))
+        Vu_perp2 = jnp.einsum("anij,bnj->bani", jnp.conj(Vmat), urelp_a)
+        t2 = 0.25 * jnp.einsum("nij,abnj->abni", PmatCa, Vu_perp + Vu_perp2)
+
+        # ----- assemble per-node 3-D forces on the row block -----
+        f_2ndPot = rho * vi_w * jnp.einsum("nij,abnj->abni", Pmat1, acc_2p)
+        f_2ndPot = f_2ndPot + ai_w[..., None] * p_2nd[..., None] * q[None, None, None, :]
+        f_2ndPot = f_2ndPot + rho * vend_w * Ca_End[None, None, :, None] * jnp.einsum(
+            "ij,abnj->abni", qM, acc_2p)
+
+        f_conv = rho * vi_w * jnp.einsum("nij,abnj->abni", Pmat1, conv)
+        f_conv = f_conv + rho * vend_w * Ca_End[None, None, :, None] * jnp.einsum(
+            "ij,abnj->abni", qM, conv)
+        # pressure-drop end term (reference applies no (i1,i2) symmetrization:
+        # p_drop = -0.25*rho*dot(P12 u1rel, conj(PmatCa u2rel)), raft_fowt.py:1593)
+        p_drop = -2 * 0.25 * 0.5 * rho * jnp.einsum("ani,bni->abn", P12u_a, jnp.conj(Pu_rel))
+        f_conv = f_conv + ai_w[..., None] * p_drop[..., None] * q[None, None, None, :]
+
+        f_axdv = rho * vi_w * jnp.einsum("nij,abnj->abni", PmatCa, axdv)
+
+        f_nabla = rho * vi_w * jnp.einsum("nij,abnj->abni", Pmat1, nab)
+        f_nabla = f_nabla + rho * vend_w * Ca_End[None, None, :, None] * jnp.einsum(
+            "ij,abnj->abni", qM, nab)
+        p_nabla = 0.25 * (
+            jnp.einsum("ani,bni->abn", gpres_a, jnp.conj(dr_n))
+            + jnp.einsum("ani,bni->ban", jnp.conj(gpres), dr_a)
+        )
+        f_nabla = f_nabla + ai_w[..., None] * p_nabla[..., None] * q[None, None, None, :]
+
+        f_rslb = rho * vi_w * (rslb + t1 - t2)
+
+        f_all = f_2ndPot + f_conv + f_axdv + f_nabla + f_rslb  # [blk,nw2,N,3]
+
+        # 6-DOF rollup about the origin (reference translates by mem.r)
+        F6 = transforms.translate_force_3to6(f_all, r[None, None, :, :])
+        return jnp.sum(F6, axis=2)  # [blk,nw2,6]
+
+    blk = min(nw2, int(os.environ.get("RAFT_TPU_QTF_BLOCK", "16")))
+    npad = ((nw2 + blk - 1) // blk) * blk
+    idx = jnp.minimum(jnp.arange(npad), nw2 - 1).reshape(-1, blk)
+    Q = jax.lax.map(pair_rows, idx).reshape(npad, nw2, 6)[:nw2]
 
     # ----- waterline (relative wave elevation) term -----
     crosses = bool(np.asarray(pose.r)[-1, 2] * np.asarray(pose.r)[0, 2] < 0)

@@ -47,7 +47,12 @@ def assemble_design(inputs, discrete_inputs, modeling_opts, turbine_opts,
             "mu_air": float(np.ravel(inputs.get("mu_air", [1.81e-5]))[0]),
             "shearExp": float(np.ravel(inputs.get("shear_exp", [0.12]))[0]),
         },
-        "cases": modeling_opts.get("cases", {"keys": [], "data": []}),
+        # deep-copied: the DLC filter must not mutate the caller's options
+        "cases": {
+            "keys": list(modeling_opts.get("cases", {}).get("keys", [])),
+            "data": [list(row) for row in
+                     modeling_opts.get("cases", {}).get("data", [])],
+        },
         "platform": {"members": [], "potModMaster": int(modeling_opts.get("potModMaster", 1))},
     }
 
@@ -61,12 +66,16 @@ def assemble_design(inputs, discrete_inputs, modeling_opts, turbine_opts,
         s_0 = np.atleast_1d(np.asarray(inputs[pre + "stations"], dtype=float))
         rA_0 = np.asarray(inputs[pre + "rA"], dtype=float)
         rB_0 = np.asarray(inputs[pre + "rB"], dtype=float)
-        ghosts = (pre + "s_ghostA" in inputs) or (pre + "s_ghostB" in inputs)
+        s_gA = float(np.ravel(inputs.get(pre + "s_ghostA", [0.0]))[0])
+        s_gB = float(np.ravel(inputs.get(pre + "s_ghostB", [1.0]))[0])
+        # trimming only activates for an actual ghost range: the OM
+        # component always declares s_ghostA/B, and at the 0/1 defaults
+        # (or with dimensional station grids) it must be a no-op
+        ghosts = ((pre + "s_ghostA" in inputs or pre + "s_ghostB" in inputs)
+                  and (s_gA > 0.0 or s_gB < 1.0))
         if ghosts:
             # WEIS normalizes stations to [0, 1] along rA->rB when it
             # supplies ghost ranges; only then is endpoint shifting valid
-            s_gA = float(np.ravel(inputs.get(pre + "s_ghostA", [0.0]))[0])
-            s_gB = float(np.ravel(inputs.get(pre + "s_ghostB", [1.0]))[0])
             idx = np.logical_and(s_0 >= s_gA, s_0 <= s_gB)
             s_grid = np.unique(np.r_[s_gA, s_0[idx], s_gB])
             rA = rA_0 + s_gA * (rB_0 - rA_0)
@@ -108,6 +117,14 @@ def assemble_design(inputs, discrete_inputs, modeling_opts, turbine_opts,
             key = pre + opt
             if key in inputs:
                 v = np.asarray(inputs[key])
+                if (opt in ("l_fill", "rho_fill") and ghosts and v.ndim
+                        and v.size == len(s_0) - 1):
+                    # per-segment arrays follow the trimmed station grid:
+                    # pick the source segment containing each new midpoint
+                    mids = 0.5 * (s_grid[1:] + s_grid[:-1])
+                    seg = np.clip(np.searchsorted(s_0, mids, side="right") - 1,
+                                  0, v.size - 1)
+                    v = v[seg]
                 mem[opt] = v.tolist() if v.ndim else v.item()
 
         # bulkheads/end caps + ring stiffeners as equivalent caps
@@ -246,6 +263,9 @@ def extract_outputs(model, outputs, rated_rotor_speed=None):
         def stat(name):
             return np.atleast_1d(outputs.get("stats_" + name, np.zeros(1)))
 
+        # reference formulas verbatim (omdao_raft.py:798-806): the *_max
+        # channels are avg+3*std statistics, and the reference takes
+        # their plain maximum (no abs-of-minimum handling)
         outputs["Max_Offset"] = float(
             np.sqrt(stat("surge_max") ** 2 + stat("sway_max") ** 2).max())
         outputs["heave_avg"] = float(stat("heave_avg").mean())

@@ -25,6 +25,7 @@ import jax.numpy as jnp
 from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
 
 from ..structure import member as mstruct
+from . import smallsolve
 
 
 def flatten_members(fowt):
@@ -226,7 +227,9 @@ def make_parametric_solver(static, n_iter=15):
             B6, Bmat = drag_terms(Xi_last)
             F0 = Fexc[0] + drag_excitation(Bmat, 0)
             Z = impedance(B6)
-            Xi = jnp.linalg.solve(Z, F0.T[:, :, None])[:, :, 0].T
+            # batch-last fused Gauss-Jordan: the framework's hottest op
+            # (Pallas kernel on TPU, ~40x over jnp.linalg.solve there)
+            Xi = smallsolve.solve_impedance(Z, F0)
             return 0.2 * Xi_last + 0.8 * Xi, None
 
         Xi0 = jnp.full((6, nw), XiStart, dtype=zeta.dtype)
@@ -235,9 +238,8 @@ def make_parametric_solver(static, n_iter=15):
         # final linearized system + response for every heading
         B6, Bmat = drag_terms(Xi_relaxed)
         Z = impedance(B6)
-        Zinv = jnp.linalg.inv(Z)
         F_all = Fexc + jax.vmap(lambda ih: drag_excitation(Bmat, ih))(jnp.arange(nH))
-        return jnp.einsum("wij,hjw->hiw", Zinv, F_all)
+        return smallsolve.solve_impedance_multi(Z, F_all)
 
     return solve
 

@@ -233,3 +233,36 @@ def test_omdao_save_designs(tmp_path):
         assert key in outputs, key
     assert outputs["stats_surge_std"].shape == (len(base["cases"]["data"]),) or \
         outputs["stats_surge_std"].ndim == 0
+
+
+def test_omdao_ghost_lfill_regrid():
+    """Per-segment l_fill/rho_fill follow the ghost-trimmed station grid."""
+    from raft_tpu.omdao import assemble_design
+
+    inputs = {
+        "mooring_water_depth": [200.0],
+        "platform_member1_rA": [0.0, 0.0, -20.0],
+        "platform_member1_rB": [0.0, 0.0, 20.0],
+        "platform_member1_stations": [0.0, 0.25, 0.5, 0.75, 1.0],
+        "platform_member1_d": [10.0, 10.0, 8.0, 6.0, 6.0],
+        "platform_member1_t": [0.05],
+        "platform_member1_l_fill": [1.0, 2.0, 3.0, 4.0],
+        "platform_member1_rho_fill": [1025.0, 1025.0, 1800.0, 1800.0],
+        "platform_member1_s_ghostA": [0.25],
+        "platform_member1_s_ghostB": [0.75],
+    }
+    design = assemble_design(
+        inputs, {}, modeling_opts={"potModMaster": 1}, turbine_opts={},
+        mooring_opts={}, member_opts={"nmembers": 1}, analysis_opts={})
+    mem = design["platform"]["members"][0]
+    assert len(mem["stations"]) == 3
+    # trimmed segments (0.25-0.5, 0.5-0.75) take the matching source values
+    assert mem["l_fill"] == [2.0, 3.0]
+    assert mem["rho_fill"] == [1025.0, 1800.0]
+    # no-ghost member passes arrays through untouched
+    inputs2 = {k: v for k, v in inputs.items()
+               if not k.endswith(("s_ghostA", "s_ghostB"))}
+    design2 = assemble_design(
+        inputs2, {}, modeling_opts={"potModMaster": 1}, turbine_opts={},
+        mooring_opts={}, member_opts={"nmembers": 1}, analysis_opts={})
+    assert design2["platform"]["members"][0]["l_fill"] == [1.0, 2.0, 3.0, 4.0]

@@ -1,0 +1,65 @@
+"""Batch-last tiny complex solves (the hot impedance-solve kernel)."""
+
+import numpy as np
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu.parallel import smallsolve
+
+
+def _random_systems(rng, B, n=6, m=1, cond="good"):
+    Z = rng.normal(size=(B, n, n)) + 1j * rng.normal(size=(B, n, n))
+    if cond == "good":
+        Z = Z + 8.0 * np.eye(n)
+    elif cond == "pivoty":
+        # zero leading diagonal entries so elimination *requires* pivoting
+        Z[:, 0, 0] = 0.0
+        Z[:, 2, 2] = 0.0
+    F = rng.normal(size=(B, n, m)) + 1j * rng.normal(size=(B, n, m))
+    return Z, F
+
+
+@pytest.mark.parametrize("cond", ["good", "pivoty"])
+def test_jnp_solver_matches_linalg(cond):
+    rng = np.random.default_rng(0)
+    Z, F = _random_systems(rng, 257, cond=cond)
+    ref = np.linalg.solve(Z, F)
+
+    Zt = jnp.asarray(Z.transpose(1, 2, 0))
+    Ft = jnp.asarray(F.transpose(1, 2, 0))
+    xr, xi = smallsolve.solve_batchlast_jnp(jnp.real(Zt), jnp.imag(Zt),
+                                            jnp.real(Ft), jnp.imag(Ft))
+    got = (np.asarray(xr) + 1j * np.asarray(xi)).transpose(2, 0, 1)
+    np.testing.assert_allclose(got, ref, rtol=1e-9, atol=1e-9)
+
+
+def test_pallas_interpret_matches_jnp():
+    rng = np.random.default_rng(1)
+    Z, F = _random_systems(rng, 130, m=3)
+    Zt = jnp.asarray(Z.transpose(1, 2, 0))
+    Ft = jnp.asarray(F.transpose(1, 2, 0))
+    args = (jnp.real(Zt), jnp.imag(Zt), jnp.real(Ft), jnp.imag(Ft))
+    xr0, xi0 = smallsolve.solve_batchlast_jnp(*args)
+    xr1, xi1 = smallsolve.solve_batchlast_pallas(*args, interpret=True)
+    np.testing.assert_allclose(np.asarray(xr1), np.asarray(xr0), atol=1e-10)
+    np.testing.assert_allclose(np.asarray(xi1), np.asarray(xi0), atol=1e-10)
+
+
+def test_impedance_wrappers():
+    rng = np.random.default_rng(2)
+    nw, nH = 40, 3
+    Z, _ = _random_systems(rng, nw)
+    F = rng.normal(size=(6, nw)) + 1j * rng.normal(size=(6, nw))
+    Fh = rng.normal(size=(nH, 6, nw)) + 1j * rng.normal(size=(nH, 6, nw))
+
+    x = np.asarray(smallsolve.solve_impedance(jnp.asarray(Z), jnp.asarray(F)))
+    ref = np.stack([np.linalg.solve(Z[i], F[:, i]) for i in range(nw)], axis=1)
+    np.testing.assert_allclose(x, ref, rtol=1e-9, atol=1e-9)
+
+    xh = np.asarray(smallsolve.solve_impedance_multi(jnp.asarray(Z), jnp.asarray(Fh)))
+    for h in range(nH):
+        ref_h = np.stack([np.linalg.solve(Z[i], Fh[h, :, i]) for i in range(nw)], axis=1)
+        np.testing.assert_allclose(xh[h], ref_h, rtol=1e-9, atol=1e-9)
+
+    Zinv = np.asarray(smallsolve.inverse_impedance(jnp.asarray(Z)))
+    np.testing.assert_allclose(Zinv, np.linalg.inv(Z), rtol=1e-9, atol=1e-9)
