@@ -753,7 +753,7 @@ class FOWT:
         mesh = mesh_mod.mesh_fowt_members(self, dz=dz, da=da)
         if meshDir:
             mesh.write_pnl(meshDir)
-        bem = PanelBEM(mesh, rho=self.rho_water, g=self.g)
+        bem = PanelBEM(mesh, rho=self.rho_water, g=self.g, depth=self.depth)
         A, B, X = bem.solve(self.w, self.k, headings_deg=headings)
         self.A_BEM = A
         self.B_BEM = B

@@ -18,10 +18,16 @@ The reference reaches the same quantities by spawning the external
 Fortran HAMS executable (raft_fowt.py:623-650); this module replaces
 that process boundary with on-device batched dense algebra.
 
-Scope/limitations (documented, graceful): infinite water depth
-(finite-depth dispersion is used for k, but the Green function is the
-deep-water one — good for kh >~ 3); no forward speed; no irregular-
-frequency removal.
+Water depth: with ``depth=None`` (or frequencies with kh > 6) the
+infinite-depth Green function is used; passing a finite ``depth`` h
+switches to the John finite-depth kernel from
+:mod:`raft_tpu.hydro.greens_fd` — per-frequency (R, z+zeta)/(R, z-zeta)
+tables, an explicit bottom-image Rankine term, and the finite-depth
+incident-wave profile in the Haskind excitation.
+
+Remaining limitations (documented, graceful): no forward speed; no
+irregular-frequency removal (accuracy degrades near interior
+resonances, e.g. ka >~ 2.5 for a hemisphere).
 """
 
 from __future__ import annotations
@@ -86,9 +92,11 @@ def _rankine_matrices(centroids, areas, normals):
 class PanelBEM:
     """Radiation/diffraction solver for one panel mesh."""
 
-    def __init__(self, mesh, rho=1025.0, g=9.81, ref_point=(0.0, 0.0, 0.0)):
+    def __init__(self, mesh, rho=1025.0, g=9.81, ref_point=(0.0, 0.0, 0.0),
+                 depth=None):
         self.rho = rho
         self.g = g
+        self.depth = None if (depth is None or not np.isfinite(depth)) else float(depth)
         areas, centroids, normals = mesh.areas_centroids_normals()
         # drop degenerate panels and waterplane lids (centroid at z=0:
         # not a wetted surface, and its free-surface image coincides
@@ -124,6 +132,36 @@ class PanelBEM:
         self.modes = jnp.asarray(modes)  # [6, N]
 
         self.table = green_table()
+
+        self.zdiff = jnp.asarray(C[:, None, 2] - C[None, :, 2])
+        self._fd_tables = {}
+        if self.depth is not None:
+            # bottom-image Rankine term (finite depth): source image about
+            # z = -h, same desingularized one-point rule as the surface
+            # image in _rankine_matrices.  Kept SEPARATE from S0/D0: it
+            # belongs to the John kernel and is only added on the
+            # finite-depth branch (the deep kernel's G has no bottom image)
+            h = self.depth
+            Cim = self.centroids * np.array([1.0, 1.0, -1.0]) \
+                + np.array([0.0, 0.0, -2.0 * h])
+            d2 = self.centroids[:, None, :] - Cim[None, :, :]
+            r2sq = np.sum(d2**2, axis=-1)
+            eps = self.areas[None, :] / SELF_TERM_COEF**2
+            S_b = self.areas[None, :] / np.sqrt(r2sq + eps)
+            G_b = -d2 / (r2sq + eps)[..., None] ** 1.5 * self.areas[None, :, None]
+            D_b = np.einsum("ijk,ik->ij", G_b, self.normals)
+            self.S_bot = jnp.asarray(S_b)
+            self.D_bot = jnp.asarray(D_b)
+
+    def _fd_table(self, K):
+        """Per-frequency finite-depth table, cached by K."""
+        from .greens_fd import GreenTableFD
+
+        key = round(float(K), 10)
+        if key not in self._fd_tables:
+            R_max = float(np.max(np.asarray(self.Rh)))
+            self._fd_tables[key] = GreenTableFD(K, self.depth, R_max)
+        return self._fd_tables[key]
 
     def _orient_normals(self):
         """Ensure normals point out of the body (into the fluid): for the
@@ -164,6 +202,42 @@ class PanelBEM:
             * self.jA[None, :]
         return S_w, D_w
 
+    def _wave_matrices_fd(self, k, tabs, res_ch, res_sh):
+        """Finite-depth wave-part S_w, D_w from the per-frequency John
+        tables (hydro/greens_fd.py): Gw = F1t + F2 + i*pi*residue.
+
+        ``tabs`` is the 6-tuple of table arrays (traced, so one jit of
+        the caller serves every frequency); ``res_ch/res_sh`` are the
+        host-precomputed residue profiles rc^0.5 * cosh/sinh(k(z+h))."""
+        from .greens_fd import lookup_f1, lookup_f2
+
+        h = self.depth
+        R = self.Rh
+        u = self.zz
+        w = self.zdiff
+
+        F1, dF1_dR, dF1_du = lookup_f1(tabs, self._fd_Rmax, h, R, u)
+        F2, dF2_dR, dF2_dw = lookup_f2(tabs, self._fd_Rmax, h, R, w)
+
+        res = res_ch[:, None] * res_ch[None, :]          # [N,N]
+        dres_dz = k * res_sh[:, None] * res_ch[None, :]  # d/dz_i
+
+        kR = k * R
+        j0A = bessel.j0(kR)
+        j1A = bessel.j1(kR)
+
+        Gw = F1 + F2 + 1j * jnp.pi * res * j0A
+        dG_dR = dF1_dR + dF2_dR - 1j * jnp.pi * res * k * j1A
+        # F2 is tabulated on |z_i - z_j|; its z_i-derivative is odd in w
+        dG_dz = dF1_du + jnp.sign(w) * dF2_dw + 1j * jnp.pi * dres_dz * j0A
+
+        gx = dG_dR * self.e_xy[..., 0]
+        gy = dG_dR * self.e_xy[..., 1]
+        S_w = Gw * self.jA[None, :]
+        D_w = (gx * self.jN[:, 0:1] + gy * self.jN[:, 1:2]
+               + dG_dz * self.jN[:, 2:3]) * self.jA[None, :]
+        return S_w, D_w
+
     def solve(self, w, k, headings_deg=(0.0,)):
         """Full first-order solution: (A [6,6,nw], B [6,6,nw],
         X [nheads,6,nw] complex excitation per unit amplitude).
@@ -180,11 +254,9 @@ class PanelBEM:
         B_out = np.zeros([6, 6, nw])
         X_out = np.zeros([len(heads), 6, nw], dtype=complex)
 
-        @jax.jit
-        def one_freq(wi, ki):
-            S_w, D_w = self._wave_matrices(ki)
-            S = (self.S0 + S_w).astype(jnp.complex128)
-            D = (self.D0 + D_w).astype(jnp.complex128)
+        def radiate_and_excite(wi, ki, S_w, D_w, S0, D0, prof, dprof):
+            S = (S0 + S_w).astype(jnp.complex128)
+            D = (D0 + D_w).astype(jnp.complex128)
             # Hess & Smith with outward normals (fluid side): the flat-
             # panel self gradient carries only the -2*pi jump
             lhs = -2.0 * jnp.pi * jnp.eye(self.n, dtype=jnp.complex128) + D
@@ -194,15 +266,14 @@ class PanelBEM:
             # F_mj = -i w rho ∬ phi_j n_m dS ;  F = (i w A - B) v
             Fr = -1j * wi * self.rho * jnp.einsum("mn,nj,n->mj", self.modes, phi_r, self.jA)
 
-            # incident wave potential (unit amplitude, e^{-i k x cos b ...})
             def incident(bh):
                 kx = ki * (self.jC[:, 0] * jnp.cos(bh) + self.jC[:, 1] * jnp.sin(bh))
-                phi0 = (self.g / wi) * jnp.exp(ki * self.jC[:, 2]) * jnp.exp(-1j * kx)
-                # normal derivative of phi0
+                phase = jnp.exp(-1j * kx)
+                phi0 = (self.g / wi) * prof * phase
                 grad = jnp.stack([
                     -1j * ki * jnp.cos(bh) * phi0,
                     -1j * ki * jnp.sin(bh) * phi0,
-                    ki * phi0,
+                    (self.g / wi) * dprof * phase,
                 ], axis=-1)
                 dphi0_dn = jnp.einsum("ni,ni->n", grad, self.jN)
                 # Haskind: X_m = -i w rho ∬ (phi0 n_m - phi_r_m dphi0/dn) dS
@@ -215,8 +286,55 @@ class PanelBEM:
             X = jax.vmap(incident)(jnp.asarray(heads))
             return Fr, X
 
+        def incident_profile(ki):
+            """Vertical profile of the incident potential and its
+            z-derivative at panel centroids, overflow-safe at any kh:
+            cosh k(z+h)/cosh kh = e^{kz}(1+e^{-2k(z+h)})/(1+e^{-2kh})."""
+            z = np.asarray(self.centroids[:, 2])
+            if self.depth is not None:
+                h = self.depth
+                den = 1.0 + np.exp(-2.0 * ki * h)
+                ekz = np.exp(ki * z)
+                prof = ekz * (1.0 + np.exp(-2.0 * ki * (z + h))) / den
+                dprof = ki * ekz * (1.0 - np.exp(-2.0 * ki * (z + h))) / den
+            else:
+                prof = np.exp(ki * z)
+                dprof = ki * prof
+            return jnp.asarray(prof), jnp.asarray(dprof)
+
+        @jax.jit
+        def one_freq_deep(wi, ki, prof, dprof):
+            S_w, D_w = self._wave_matrices(ki)
+            return radiate_and_excite(wi, ki, S_w, D_w, self.S0, self.D0,
+                                      prof, dprof)
+
+        @jax.jit
+        def one_freq_fd(wi, ki, tabs, res_ch, res_sh, prof, dprof):
+            S_w, D_w = self._wave_matrices_fd(ki, tabs, res_ch, res_sh)
+            # the John kernel pairs with the bottom-image Rankine term
+            return radiate_and_excite(wi, ki, S_w, D_w,
+                                      self.S0 + self.S_bot,
+                                      self.D0 + self.D_bot, prof, dprof)
+
         for i in range(nw):
-            Fr, X = one_freq(float(w_np[i]), float(k_np[i]))
+            wi, ki = float(w_np[i]), float(k_np[i])
+            prof, dprof = incident_profile(ki)
+            # per-frequency kernel choice: John tables in the finite-depth
+            # regime, deep-water table when the bottom is invisible
+            if self.depth is not None and ki * self.depth < 100.0:
+                from .greens_fd import residue_coef
+
+                tab = self._fd_table(wi**2 / self.g)
+                self._fd_Rmax = tab.R_max
+                rc = residue_coef(tab.K, self.depth, tab.k)
+                z = np.asarray(self.centroids[:, 2])
+                arg = np.minimum(tab.k * (z + self.depth), 300.0)
+                res_ch = jnp.asarray(np.sqrt(rc) * np.cosh(arg))
+                res_sh = jnp.asarray(np.sqrt(rc) * np.sinh(arg))
+                Fr, X = one_freq_fd(wi, ki, tab.jarrays(), res_ch, res_sh,
+                                    prof, dprof)
+            else:
+                Fr, X = one_freq_deep(wi, ki, prof, dprof)
             # F = (i w A - B) v with unit velocity amplitude (e^{-i w t};
             # validated by the Haskind energy identity in tests/test_bem.py)
             A_out[:, :, i] = np.imag(np.asarray(Fr)) / w_np[i]

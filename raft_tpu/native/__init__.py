@@ -76,8 +76,12 @@ def lib():
                 pass
             return None
         L.raft_native_abi_version.restype = ctypes.c_int
-        if L.raft_native_abi_version() != 2:
+        if L.raft_native_abi_version() != 3:
             return None
+        L.raft_pv_fd_points.argtypes = [
+            ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
+            ctypes.c_int64, ctypes.c_double, ctypes.c_double, ctypes.c_double,
+            ctypes.c_int, ctypes.c_int, ctypes.POINTER(ctypes.c_double)]
         L.raft_rankine_assemble.argtypes = [
             ctypes.POINTER(ctypes.c_double), ctypes.POINTER(ctypes.c_double),
             ctypes.POINTER(ctypes.c_double), ctypes.c_int64, ctypes.c_double,
@@ -119,6 +123,22 @@ def pv_points(A, V, n_gauss=200):
     L.raft_pv_points(_dptr(A), _dptr(V), ctypes.c_int64(len(A)),
                      ctypes.c_int(n_gauss), _dptr(out))
     return out.reshape(shape)
+
+
+def pv_fd_points(R, s, K, h, k, kind, n_gauss=160):
+    """Finite-depth John-kernel PV integral at points, or None if the
+    native lib is absent (see hydro/greens_fd.py for the definition)."""
+    L = lib()
+    if L is None:
+        return None
+    R = np.ascontiguousarray(np.asarray(R, dtype=np.float64).ravel())
+    s = np.ascontiguousarray(np.asarray(s, dtype=np.float64).ravel())
+    out = np.empty(R.shape, dtype=np.float64)
+    L.raft_pv_fd_points(_dptr(R), _dptr(s), ctypes.c_int64(len(R)),
+                        ctypes.c_double(K), ctypes.c_double(h),
+                        ctypes.c_double(k), ctypes.c_int(kind),
+                        ctypes.c_int(n_gauss), _dptr(out))
+    return out
 
 
 def rankine_assemble(centroids, areas, normals, c_self):

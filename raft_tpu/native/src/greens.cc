@@ -185,6 +185,72 @@ void raft_rankine_assemble(const double* centroids, const double* areas,
   });
 }
 
-int raft_native_abi_version() { return 2; }
+// ---------------------------------------------------------------------
+// Finite-depth John-kernel PV integrals (see hydro/greens_fd.py):
+//   kind 1: PV int [ g(mu) cosh(mu(s+2h)) - e^{mu s} ] J0(mu R) dmu
+//   kind 2: PV int   g(mu) cosh(mu s)                  J0(mu R) dmu
+// with g(mu) = (mu+K) e^{-mu h} / (mu sinh(mu h) - K cosh(mu h)) and the
+// simple pole at mu = k (k tanh kh = K) removed by residue subtraction.
+void raft_pv_fd_points(const double* R, const double* s, int64_t n, double K,
+                       double h, double k, int kind, int n_gauss, double* out) {
+  PvRule rule(n_gauss);
+  const double Dp = std::sinh(k * h) + k * h * std::cosh(k * h)
+                    - K * h * std::sinh(k * h);
+  parallel_for(n, [&](int64_t lo, int64_t hi) {
+    for (int64_t p = lo; p < hi; ++p) {
+      const double Rp = R[p];
+      const double sp = s[p];
+
+      auto integrand = [&](double mu) -> double {
+        // overflow-safe form: with X = e^{-2 mu h} and
+        // den = (mu-K) - (mu+K) X, all exponents are <= 0
+        const double J = bessel_j0(mu * Rp);
+        const double X = std::exp(-2.0 * mu * h);
+        const double den = (mu - K) - (mu + K) * X;
+        if (kind == 1) {
+          const double num = std::exp(mu * sp) + std::exp(-mu * (sp + 4.0 * h));
+          return ((mu + K) * num / den - std::exp(mu * sp)) * J;
+        }
+        const double num = std::exp(-mu * (2.0 * h - sp))
+                           + std::exp(-mu * (2.0 * h + sp));
+        return (mu + K) * num / den * J;
+      };
+
+      const double res_ch = (kind == 1) ? std::cosh(k * (sp + 2.0 * h))
+                                        : std::cosh(k * sp);
+      const double resJ = (k + K) * std::exp(-k * h) * res_ch / Dp
+                          * bessel_j0(k * Rp);
+
+      // regularized [0, 2k]
+      double part1 = 0.0;
+      for (int g = 0; g < rule.n_gauss; ++g) {
+        const double mu = (rule.x200[g] + 1.0) * k;
+        const double w = rule.w200[g] * k;
+        if (std::abs(mu - k) > 1e-12 * k)
+          part1 += w * (integrand(mu) - resJ / (mu - k));
+      }
+
+      // tail [2k, T] with oscillation-aware panels
+      double decay = (kind == 1) ? std::min(sp, -1e-3)
+                                 : std::abs(sp) - 2.0 * h;
+      double T = 2.0 * k + std::max(20.0, 40.0 / std::max(-decay, 0.15));
+      T = std::min(T, 2.0 * k + 2000.0);
+      const double panel_len =
+          std::min(1.0, M_PI / (2.0 * std::max(Rp, 1e-6) + 1.0));
+      const int n_panels = (int)std::ceil((T - 2.0 * k) / panel_len);
+      const double hp = (T - 2.0 * k) / n_panels;
+      double part2 = 0.0;
+      for (int pp = 0; pp < n_panels; ++pp) {
+        const double lo2 = 2.0 * k + pp * hp;
+        const double mid = lo2 + 0.5 * hp, half = 0.5 * hp;
+        for (int g = 0; g < 8; ++g)
+          part2 += half * rule.w8[g] * integrand(mid + half * rule.x8[g]);
+      }
+      out[p] = part1 + part2;
+    }
+  });
+}
+
+int raft_native_abi_version() { return 3; }
 
 }  // extern "C"

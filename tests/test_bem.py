@@ -158,3 +158,35 @@ def test_bem_in_calcbem_path(tmp_path):
     assert np.all(np.isfinite(fowt.A_BEM))
     assert np.any(np.abs(fowt.X_BEM) > 0)
     assert (tmp_path / "HullMesh.pnl").exists()
+
+
+def test_finite_depth_energy_and_deep_limit():
+    """Finite-depth John-kernel solver: Haskind energy identity in the
+    strongly finite-depth regime, and agreement with the deep-water
+    solver when kh is large."""
+    from raft_tpu.hydro.greens_fd import wavenumber
+
+    mesh = hemi_mesh()
+    h = 2.0  # depth = 2 radii
+    Ks = np.array([0.2, 1.0])
+    ks = np.array([wavenumber(K, h) for K in Ks])
+    ws = np.sqrt(G * Ks)
+    bem = PanelBEM(mesh, rho=RHO, g=G, depth=h)
+    A, B, X = bem.solve(ws, ks, headings_deg=[0.0])
+    for i in range(len(Ks)):
+        k, w = ks[i], ws[i]
+        Cg = (w / (2 * k)) * (1 + 2 * k * h / np.sinh(2 * k * h))
+        B33_energy = k * abs(X[0, 2, i]) ** 2 / (4 * RHO * G * Cg)
+        assert B[2, 2, i] == pytest.approx(B33_energy, rel=0.06)
+        assert A[2, 2, i] > 0
+
+    # kh >> 1: finite-depth solver reproduces the deep-water solver
+    ka = np.array([1.0])
+    wd = np.sqrt(G * ka)
+    Ad, Bd, Xd = PanelBEM(mesh, rho=RHO, g=G).solve(wd, ka, headings_deg=[0.0])
+    h2 = 12.0
+    k2 = np.array([wavenumber(K, h2) for K in ka])
+    A2, B2, X2 = PanelBEM(mesh, rho=RHO, g=G, depth=h2).solve(wd, k2, headings_deg=[0.0])
+    assert A2[2, 2, 0] == pytest.approx(Ad[2, 2, 0], rel=0.01)
+    assert B2[2, 2, 0] == pytest.approx(Bd[2, 2, 0], rel=0.01)
+    assert abs(X2[0, 2, 0]) == pytest.approx(abs(Xd[0, 2, 0]), rel=0.01)

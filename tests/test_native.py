@@ -62,3 +62,19 @@ def test_rankine_assembly_matches_numpy(monkeypatch):
     S0p, D0p = potential_bem._rankine_matrices(C, A, N)
     np.testing.assert_allclose(S0n, S0p, atol=1e-12)
     np.testing.assert_allclose(D0n, D0p, atol=1e-12)
+
+
+def test_pv_fd_matches_numpy():
+    """Finite-depth John-kernel PV quadrature: native vs NumPy rule."""
+    from raft_tpu.hydro import greens_fd as gfd
+
+    K, h = 0.8, 3.0
+    k = gfd.wavenumber(K, h)
+    rng = np.random.default_rng(0)
+    R = rng.uniform(0.01, 5, 25)
+    u = rng.uniform(-2 * h + 0.01, -0.01, 25)
+    w = rng.uniform(0, h, 25)
+    for kind, s in ((1, u), (2, w)):
+        nat = native.pv_fd_points(R, s, K, h, k, kind)
+        ref = gfd._pv_fd_numpy(R, s, K, h, k, kind)
+        np.testing.assert_allclose(nat, ref, atol=1e-10)
