@@ -76,8 +76,14 @@ class Model:
                     body_coords = [
                         [fi["x_location"], fi["y_location"]] for fi in fowtInfo
                     ]
+                    moor_file = design["array_mooring"]["file"]
+                    if not os.path.exists(moor_file) and design.get("_design_dir"):
+                        # resolve relative to the design YAML's directory
+                        cand = os.path.join(design["_design_dir"], moor_file)
+                        if os.path.exists(cand):
+                            moor_file = cand
                     self.ms = moorsys.compile_moordyn_file(
-                        design["array_mooring"]["file"], depth=self.depth,
+                        moor_file, depth=self.depth,
                         body_coords=body_coords,
                     )
                 else:

@@ -44,15 +44,18 @@ from scipy.special import j0 as _j0
 
 
 def wavenumber(K, h):
-    """Positive real root of k tanh kh = K (fixed-point, like the deep
-    solver in ops.waves but for scalar host use)."""
-    k = max(K, np.sqrt(K / h))
-    for _ in range(100):
-        k_new = K / np.tanh(k * h)
-        if abs(k_new - k) < 1e-14 * max(k, 1.0):
-            k = k_new
+    """Positive real root of k tanh kh = K by Newton iteration (the
+    K/tanh fixed point loses its contraction as kh -> 0, so Newton is
+    required for the shallow regime this kernel targets)."""
+    k = max(K, np.sqrt(K / h))  # deep / shallow asymptotes as the seed
+    for _ in range(50):
+        th = np.tanh(k * h)
+        f = k * th - K
+        fp = th + k * h * (1.0 - th * th)
+        dk = f / fp
+        k -= dk
+        if abs(dk) < 1e-14 * max(k, 1.0):
             break
-        k = k_new
     return float(k)
 
 
@@ -212,6 +215,9 @@ class GreenTableFD:
         self._j = {name: jnp.asarray(getattr(self, name))
                    for name in ("F1", "F2", "dF1_dR", "dF1_du",
                                 "dF2_dR", "dF2_dw")}
+        # free the host copies: consumers go through jarrays()/f1()/f2()
+        for name in ("F1", "F2", "dF1_dR", "dF1_du", "dF2_dR", "dF2_dw"):
+            setattr(self, name, None)
 
     # -- lookups (device-side) ------------------------------------------
 

@@ -133,9 +133,9 @@ class PanelBEM:
 
         self.table = green_table()
 
-        self.zdiff = jnp.asarray(C[:, None, 2] - C[None, :, 2])
         self._fd_tables = {}
         if self.depth is not None:
+            self.zdiff = jnp.asarray(C[:, None, 2] - C[None, :, 2])
             # bottom-image Rankine term (finite depth): source image about
             # z = -h, same desingularized one-point rule as the surface
             # image in _rankine_matrices.  Kept SEPARATE from S0/D0: it
@@ -153,12 +153,17 @@ class PanelBEM:
             self.S_bot = jnp.asarray(S_b)
             self.D_bot = jnp.asarray(D_b)
 
+    _FD_CACHE_MAX = 64
+
     def _fd_table(self, K):
-        """Per-frequency finite-depth table, cached by K."""
+        """Per-frequency finite-depth table, cached by K (FIFO-capped:
+        each table holds six device arrays, ~1.2 MB)."""
         from .greens_fd import GreenTableFD
 
         key = round(float(K), 10)
         if key not in self._fd_tables:
+            if len(self._fd_tables) >= self._FD_CACHE_MAX:
+                self._fd_tables.pop(next(iter(self._fd_tables)))
             R_max = float(np.max(np.asarray(self.Rh)))
             self._fd_tables[key] = GreenTableFD(K, self.depth, R_max)
         return self._fd_tables[key]
@@ -320,8 +325,9 @@ class PanelBEM:
             wi, ki = float(w_np[i]), float(k_np[i])
             prof, dprof = incident_profile(ki)
             # per-frequency kernel choice: John tables in the finite-depth
-            # regime, deep-water table when the bottom is invisible
-            if self.depth is not None and ki * self.depth < 100.0:
+            # regime; beyond kh ~ 6 the deep-water kernel matches to 0.1%
+            # (see tests) and costs no per-frequency table build
+            if self.depth is not None and ki * self.depth < 6.0:
                 from .greens_fd import residue_coef
 
                 tab = self._fd_table(wi**2 / self.g)

@@ -53,8 +53,6 @@ def compile_polars(turbine: dict, ir: int):
     for i in range(n_af):
         Ca[i, :] = turbine["airfoils"][i].get("added_mass_coeff", [0.5, 1.0])
 
-    cpmin_flag = len(np.array(turbine["airfoils"][0]["data"])[0]) > 4
-
     cl = np.zeros((n_af, len(aoa)))
     cd = np.zeros((n_af, len(aoa)))
     cm = np.zeros((n_af, len(aoa)))
@@ -64,7 +62,9 @@ def compile_polars(turbine: dict, ir: int):
         cl[i] = np.interp(aoa, tab[:, 0], tab[:, 1])
         cd[i] = np.interp(aoa, tab[:, 0], tab[:, 2])
         cm[i] = np.interp(aoa, tab[:, 0], tab[:, 3])
-        if cpmin_flag:
+        # cpmin column is optional PER AIRFOIL (raft_rotor.py:211-226);
+        # mixed 4/5-column polar sets appear in e.g. FOCTT_example.yaml
+        if tab.shape[1] > 4:
             cpmin[i] = np.interp(aoa, tab[:, 0], tab[:, 4])
         # enforce +/-180 deg continuity (raft_rotor.py:229-240)
         for arr in (cl, cd, cm, cpmin):

@@ -77,10 +77,20 @@ def get_from_dict(d, key, shape=0, dtype=float, default=None, index=None):
 
 
 def load_design(path_or_dict):
-    """Load a RAFT design YAML (or pass through an already-parsed dict)."""
+    """Load a RAFT design YAML (or pass through an already-parsed dict).
+
+    The source directory is recorded as ``_design_dir`` so relative
+    auxiliary paths inside the design (e.g. the array_mooring MoorDyn
+    file of VolturnUS-S_farm.yaml) resolve against the YAML's location,
+    like running the reference from its designs/ directory."""
     if isinstance(path_or_dict, dict):
         return path_or_dict
+    import os
+
     import yaml
 
     with open(path_or_dict) as f:
-        return yaml.load(f, Loader=yaml.FullLoader)
+        design = yaml.load(f, Loader=yaml.FullLoader)
+    if isinstance(design, dict):
+        design.setdefault("_design_dir", os.path.dirname(os.path.abspath(path_or_dict)))
+    return design

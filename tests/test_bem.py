@@ -180,13 +180,23 @@ def test_finite_depth_energy_and_deep_limit():
         assert B[2, 2, i] == pytest.approx(B33_energy, rel=0.06)
         assert A[2, 2, i] > 0
 
-    # kh >> 1: finite-depth solver reproduces the deep-water solver
+    # near the kernel switch (kh just under 6, the deepest the John
+    # branch runs): finite-depth solver reproduces the deep-water solver
     ka = np.array([1.0])
     wd = np.sqrt(G * ka)
     Ad, Bd, Xd = PanelBEM(mesh, rho=RHO, g=G).solve(wd, ka, headings_deg=[0.0])
-    h2 = 12.0
+    h2 = 5.5
     k2 = np.array([wavenumber(K, h2) for K in ka])
-    A2, B2, X2 = PanelBEM(mesh, rho=RHO, g=G, depth=h2).solve(wd, k2, headings_deg=[0.0])
-    assert A2[2, 2, 0] == pytest.approx(Ad[2, 2, 0], rel=0.01)
-    assert B2[2, 2, 0] == pytest.approx(Bd[2, 2, 0], rel=0.01)
-    assert abs(X2[0, 2, 0]) == pytest.approx(abs(Xd[0, 2, 0]), rel=0.01)
+    bem2 = PanelBEM(mesh, rho=RHO, g=G, depth=h2)
+    A2, B2, X2 = bem2.solve(wd, k2, headings_deg=[0.0])
+    assert len(bem2._fd_tables) == 1  # the John branch actually ran
+    assert A2[2, 2, 0] == pytest.approx(Ad[2, 2, 0], rel=0.02)
+    assert B2[2, 2, 0] == pytest.approx(Bd[2, 2, 0], rel=0.02)
+    assert abs(X2[0, 2, 0]) == pytest.approx(abs(Xd[0, 2, 0]), rel=0.02)
+    # and past the switch the deep branch serves without table builds
+    h3 = 12.0
+    k3 = np.array([wavenumber(K, h3) for K in ka])
+    bem3 = PanelBEM(mesh, rho=RHO, g=G, depth=h3)
+    A3, _, _ = bem3.solve(wd, k3, headings_deg=[0.0])
+    assert len(bem3._fd_tables) == 0
+    assert A3[2, 2, 0] == pytest.approx(Ad[2, 2, 0], rel=0.01)
