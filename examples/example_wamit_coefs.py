@@ -1,0 +1,32 @@
+"""Run RAFT with precomputed WAMIT hydrodynamic coefficients
+(reference examples/example-WAMIT_Coefs.py pattern): the platform's
+``hydroPath`` points at WAMIT-format .1/.3/.12d files; the BEM solver
+is never invoked and second-order forces come from the read QTF."""
+
+
+def main():
+    import jax
+
+    try:
+        jax.config.update("jax_platforms", "cpu")
+    except Exception:
+        pass
+    jax.config.update("jax_enable_x64", True)
+
+    import os
+
+    import raft_tpu
+
+    ref = "/root/reference/examples/OC4semi-WAMIT_Coefs.yaml"
+    if not os.path.exists(ref):
+        print("reference WAMIT-Coefs example not found; nothing to demo")
+        return
+    model = raft_tpu.Model(ref)
+    model.analyzeUnloaded()
+    model.analyzeCases(display=1)
+    cm = model.results["case_metrics"][0][0]
+    print("surge_std:", cm["surge_std"], "m;  pitch_std:", cm["pitch_std"], "deg")
+
+
+if __name__ == "__main__":
+    main()

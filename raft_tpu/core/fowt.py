@@ -23,7 +23,7 @@ import jax
 import jax.numpy as jnp
 
 from ..ops import transforms, waves
-from ..schema import get_from_dict
+from ..schema import get_from_dict, resolve_path
 from ..structure import member as mstruct
 from ..mooring import system as moorsys
 from ..rotor import Rotor
@@ -312,11 +312,11 @@ class FOWT:
         self.X_BEM = np.zeros([1, 6, self.nw], dtype=complex)
         self.BEM_headings = np.array([0.0])
         if "hydroPath" in platform:
-            self.hydroPath = platform["hydroPath"]
+            self.hydroPath = resolve_path(design, platform["hydroPath"],
+                                          suffixes=(".1", ".3", ".12d"))
         if self.potFirstOrder == 1:
             if "hydroPath" not in platform:
                 raise Exception("If potFirstOrder==1, then hydroPath must be specified in the platform input.")
-            self.hydroPath = platform["hydroPath"]
             self.readHydro()
 
         # ----- second-order hydro configuration (raft_fowt.py:230-257) -----
@@ -337,7 +337,8 @@ class FOWT:
         elif self.potSecOrder == 2:
             if "hydroPath" not in platform:
                 raise Exception("If potSecOrder==2, then hydroPath must be specified in the platform input.")
-            self.qtfPath = platform["hydroPath"] + ".12d"
+            self.qtfPath = resolve_path(design, platform["hydroPath"],
+                                        suffixes=(".12d",)) + ".12d"
             from ..hydro import second_order as so
             so.read_qtf(self, self.qtfPath)
         self.outFolderQTF = platform.get("outFolderQTF", None)

@@ -24,7 +24,7 @@ import os
 import numpy as np
 import jax.numpy as jnp
 
-from ..schema import get_from_dict, load_design
+from ..schema import get_from_dict, load_design, resolve_path
 from ..ops import waves
 from ..mooring import system as moorsys
 from .fowt import FOWT, _sorted_eigen
@@ -76,12 +76,7 @@ class Model:
                     body_coords = [
                         [fi["x_location"], fi["y_location"]] for fi in fowtInfo
                     ]
-                    moor_file = design["array_mooring"]["file"]
-                    if not os.path.exists(moor_file) and design.get("_design_dir"):
-                        # resolve relative to the design YAML's directory
-                        cand = os.path.join(design["_design_dir"], moor_file)
-                        if os.path.exists(cand):
-                            moor_file = cand
+                    moor_file = resolve_path(design, design["array_mooring"]["file"])
                     self.ms = moorsys.compile_moordyn_file(
                         moor_file, depth=self.depth,
                         body_coords=body_coords,
@@ -97,6 +92,8 @@ class Model:
                 headj = fowtInfo[i]["heading_adjust"]
 
                 design_i = {"site": design["site"]}
+                if "_design_dir" in design:  # keep design-relative paths resolvable
+                    design_i["_design_dir"] = design["_design_dir"]
                 if fowtInfo[i]["turbineID"] == 0:
                     design_i.pop("turbine", None)
                 else:

@@ -94,3 +94,25 @@ def load_design(path_or_dict):
     if isinstance(design, dict):
         design.setdefault("_design_dir", os.path.dirname(os.path.abspath(path_or_dict)))
     return design
+
+
+def resolve_path(design, path, suffixes=("",)):
+    """Resolve an auxiliary file path referenced inside a design.
+
+    Reference designs use paths relative to wherever the reference was
+    run from (its repo root for the WAMIT examples, the designs dir for
+    the farm MoorDyn file), so try: as given, relative to the design
+    YAML's directory, and relative to its parent.  ``suffixes`` lets
+    callers check basename-style paths like WAMIT's ``marin_semi``
+    (checked as ``marin_semi.1``)."""
+    import os
+
+    base = design.get("_design_dir") if isinstance(design, dict) else None
+    candidates = [path]
+    if base:
+        candidates += [os.path.join(base, path),
+                       os.path.normpath(os.path.join(base, "..", path))]
+    for cand in candidates:
+        if any(os.path.exists(cand + sfx) for sfx in suffixes):
+            return cand
+    return path
