@@ -337,8 +337,9 @@ class FOWT:
         elif self.potSecOrder == 2:
             if "hydroPath" not in platform:
                 raise Exception("If potSecOrder==2, then hydroPath must be specified in the platform input.")
-            self.qtfPath = resolve_path(design, platform["hydroPath"],
-                                        suffixes=(".12d",)) + ".12d"
+            # hydroPath was resolved above; keep one source of truth so the
+            # .1/.3 and .12d files always come from the same directory
+            self.qtfPath = self.hydroPath + ".12d"
             from ..hydro import second_order as so
             so.read_qtf(self, self.qtfPath)
         self.outFolderQTF = platform.get("outFolderQTF", None)

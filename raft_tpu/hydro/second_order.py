@@ -41,7 +41,41 @@ from ..structure import member as mstruct
 # ---------------------------------------------------------------------------
 
 
-def _member_qtf(topo, geom, pose, w2nd, k2nd, beta, depth, Xi, rho, g):
+def _run_pair_rows(pair_rows, nw2, blk, seq_devices=None):
+    """Evaluate the (w1, w2) plane in w1-row blocks.
+
+    Single device: `lax.map` over row blocks (bounded memory).  With
+    ``seq_devices``, the row blocks are sharded over a 1-D 'seq' device
+    mesh via shard_map — the sequence-parallel axis of this framework
+    (SURVEY.md §5): the pair plane has no sequential dependency, so no
+    ring/all-to-all is needed, just block ownership and the implicit
+    output all-gather.
+    """
+    if seq_devices is None or len(seq_devices) <= 1:
+        npad = ((nw2 + blk - 1) // blk) * blk
+        idx = jnp.minimum(jnp.arange(npad), nw2 - 1).reshape(-1, blk)
+        return jax.lax.map(pair_rows, idx).reshape(npad, nw2, 6)[:nw2]
+
+    from jax.sharding import Mesh, PartitionSpec as P
+    from jax import shard_map
+
+    nd = len(seq_devices)
+    blk = min(blk, -(-nw2 // nd))  # don't pad past ~1 block per device
+    step = blk * nd
+    npad = ((nw2 + step - 1) // step) * step
+    idx = jnp.minimum(jnp.arange(npad), nw2 - 1).reshape(-1, blk)
+    mesh = Mesh(np.asarray(seq_devices), ("seq",))
+
+    def local(idx_loc):
+        return jax.lax.map(pair_rows, idx_loc)
+
+    out = shard_map(local, mesh=mesh, in_specs=P("seq"),
+                    out_specs=P("seq"))(idx)
+    return out.reshape(npad, nw2, 6)[:nw2]
+
+
+def _member_qtf(topo, geom, pose, w2nd, k2nd, beta, depth, Xi, rho, g,
+                seq_devices=None):
     """Upper-triangle QTF contribution of one member, [nw2, nw2, 6].
 
     ``Xi`` [6, nw2] are motion RAOs on the 2nd-order frequency grid.
@@ -203,9 +237,7 @@ def _member_qtf(topo, geom, pose, w2nd, k2nd, beta, depth, Xi, rho, g):
         return jnp.sum(F6, axis=2)  # [blk,nw2,6]
 
     blk = min(nw2, int(os.environ.get("RAFT_TPU_QTF_BLOCK", "16")))
-    npad = ((nw2 + blk - 1) // blk) * blk
-    idx = jnp.minimum(jnp.arange(npad), nw2 - 1).reshape(-1, blk)
-    Q = jax.lax.map(pair_rows, idx).reshape(npad, nw2, 6)[:nw2]
+    Q = _run_pair_rows(pair_rows, nw2, blk, seq_devices=seq_devices)
 
     # ----- waterline (relative wave elevation) term -----
     crosses = bool(np.asarray(pose.r)[-1, 2] * np.asarray(pose.r)[0, 2] < 0)
@@ -431,7 +463,8 @@ def calc_qtf_slender_body(fowt, waveHeadInd, Xi0=None, verbose=False, iCase=None
         if r_np[0, 2] > 0 and r_np[-1, 2] > 0:
             continue
         qtf += np.asarray(_member_qtf(cm.topo, cm.geom, pose, w2nd, k2nd, beta,
-                                      fowt.depth, Xij, fowt.rho_water, fowt.g))
+                                      fowt.depth, Xij, fowt.rho_water, fowt.g,
+                                      seq_devices=getattr(fowt, "qtf_seq_devices", None)))
         qtf += _kim_and_yue(cm.topo, cm.geom, pose, fowt.w1_2nd, fowt.k1_2nd, beta,
                             fowt.depth, fowt.rho_water, fowt.g) * tri[:, :, None]
 

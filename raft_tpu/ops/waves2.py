@@ -131,10 +131,18 @@ def pot2nd(w1, w2, k1, k2, beta, depth, r, g=9.81, rho=1025.0):
     ) / denom
     aux = 0.5 * (gamma_21 + jnp.conj(gamma_12))
 
+    # deep-water-safe ratios, like _khz_ratios: beyond kh >= 10 the
+    # cosh/cosh form is replaced by its e^{kz} limit (ratio error
+    # ~e^{-2kh} < 2e-9) so float32 never overflows the cosh
+    kd_h = norm_kd * depth
+    deep = kd_h >= _DEEP_KH
     kzh = jnp.clip(norm_kd * (z + depth), -600.0, 600.0)
-    khc = jnp.clip(norm_kd * depth, 1e-12, 600.0)
-    khz_xy = jnp.cosh(kzh) / jnp.cosh(khc)
-    khz_z = jnp.sinh(kzh) / jnp.cosh(khc)
+    khc = jnp.clip(kd_h, 1e-12, 600.0)
+    ekz = jnp.exp(jnp.clip(norm_kd * z, -600.0, 0.0))
+    khz_xy = jnp.where(deep, ekz, jnp.cosh(jnp.minimum(kzh, 2 * _DEEP_KH))
+                       / jnp.cosh(jnp.minimum(khc, 2 * _DEEP_KH)))
+    khz_z = jnp.where(deep, ekz, jnp.sinh(jnp.clip(kzh, -2 * _DEEP_KH, 2 * _DEEP_KH))
+                      / jnp.cosh(jnp.minimum(khc, 2 * _DEEP_KH)))
 
     phase = jnp.exp(-1j * (kdx * r[..., 0] + kdy * r[..., 1]))
     base = aux * khz_xy * phase

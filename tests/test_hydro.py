@@ -133,3 +133,38 @@ def test_hydro_force_2nd_analytic():
     assert np.allclose(f_mean, expected, rtol=1e-12)
     assert famp.shape == (6, nw)
     assert np.all(np.isfinite(famp))
+
+
+def test_qtf_sequence_parallel_matches_single_device():
+    """The (w1, w2) QTF plane sharded over a 'seq' device mesh (the
+    sequence-parallel axis, SURVEY.md §5) reproduces the single-device
+    result exactly."""
+    import jax
+
+    from raft_tpu.core.fowt import FOWT
+    from raft_tpu.designs import demo_spar
+    from raft_tpu.hydro import second_order as so
+
+    design = demo_spar(nw_freqs=(0.05, 0.4))
+    design["platform"]["potSecOrder"] = 1
+    design["platform"]["min_freq2nd"] = 0.05
+    design["platform"]["max_freq2nd"] = 0.35
+    design["platform"]["df_freq2nd"] = 0.02
+    w = np.arange(0.05, 0.4, 0.05) * 2 * np.pi
+    fowt = FOWT(design, w, depth=320.0)
+    fowt.setPosition(np.zeros(6))
+    fowt.calcStatics()
+    fowt.calcHydroConstants()
+    case = dict(zip(design["cases"]["keys"], design["cases"]["data"][0]))
+    fowt.calcHydroExcitation(case)
+    rng = np.random.default_rng(3)
+    Xi0 = rng.normal(size=(6, fowt.nw)) + 1j * rng.normal(size=(6, fowt.nw))
+
+    q_single = so.calc_qtf_slender_body(fowt, 0, Xi0=Xi0).copy()
+    fowt.qtf_seq_devices = jax.devices()[:8]
+    try:
+        q_sharded = so.calc_qtf_slender_body(fowt, 0, Xi0=Xi0).copy()
+    finally:
+        fowt.qtf_seq_devices = None
+    assert len(jax.devices()) >= 8  # conftest forces the 8-device CPU mesh
+    np.testing.assert_allclose(q_sharded, q_single, rtol=1e-12, atol=1e-9)
