@@ -340,6 +340,11 @@ class FOWT:
             # hydroPath was resolved above; keep one source of truth so the
             # .1/.3 and .12d files always come from the same directory
             self.qtfPath = self.hydroPath + ".12d"
+            import os as _os
+            if not _os.path.exists(self.qtfPath):
+                raise FileNotFoundError(
+                    f"potSecOrder==2 needs '{self.qtfPath}' next to the other "
+                    "WAMIT coefficient files (the .1/.3/.12d set must be co-located)")
             from ..hydro import second_order as so
             so.read_qtf(self, self.qtfPath)
         self.outFolderQTF = platform.get("outFolderQTF", None)

@@ -26,6 +26,7 @@ import jax.numpy as jnp
 
 from ..schema import get_from_dict, load_design, resolve_path
 from ..ops import waves
+from .. import profiling
 from ..mooring import system as moorsys
 from .fowt import FOWT, _sorted_eigen
 
@@ -166,29 +167,40 @@ class Model:
         self.results["case_metrics"] = {}
         self.results["mean_offsets"] = []
 
-        for fowt in self.fowtList:
-            fowt.setPosition([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0])
-            fowt.calcStatics()
-        for fowt in self.fowtList:
-            fowt.calcBEM(meshDir=meshDir)
+        with profiling.phase("statics"):
+            for fowt in self.fowtList:
+                fowt.setPosition([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0])
+                fowt.calcStatics()
+        with profiling.phase("BEM"):
+            for fowt in self.fowtList:
+                fowt.calcBEM(meshDir=meshDir)
 
         for iCase in range(nCases):
             if display > 0:
                 print(f"\n--------------------- Running Case {iCase+1} ----------------------")
                 print(self.design["cases"]["data"][iCase])
+            t_before = profiling.report()
 
             case = dict(zip(self.design["cases"]["keys"], self.design["cases"]["data"][iCase]))
             case["iCase"] = iCase
 
             self.results["case_metrics"][iCase] = {}
-            self.solveStatics(case, display=display)
-            self.solveDynamics(case, display=display)
+            with profiling.phase("solveStatics"):
+                self.solveStatics(case, display=display)
+            with profiling.phase("solveDynamics"):
+                self.solveDynamics(case, display=display)
 
             if any(fowt.potSecOrder > 0 for fowt in self.fowtList):
                 self.solveStatics(case)
                 for fowt in self.fowtList:
                     fowt.Fhydro_2nd_mean *= 0
 
+            if display >= 2:
+                # per-case phase timing (delta of the process-global totals)
+                for ph, tot in profiling.report().items():
+                    dt = tot - t_before.get(ph, 0.0)
+                    if dt > 0:
+                        print(f"  [timing] {ph}: {dt:.3f} s")
             for i, fowt in enumerate(self.fowtList):
                 self.results["case_metrics"][iCase][i] = {}
                 fowt.saveTurbineOutputs(self.results["case_metrics"][iCase][i], case)

@@ -420,6 +420,13 @@ def _kim_and_yue(topo, geom, pose, w2nd, k2nd, beta, depth, rho, g, Nm=10):
 def calc_qtf_slender_body(fowt, waveHeadInd, Xi0=None, verbose=False, iCase=None, iWT=None):
     """Slender-body QTF for one wave heading; fills fowt.qtf
     [nw1_2nd, nw2_2nd, nheads, 6] (raft_fowt.py:1385-1648)."""
+    from .. import profiling
+    with profiling.phase("QTF"):
+        return _calc_qtf_slender_body(fowt, waveHeadInd, Xi0=Xi0, verbose=verbose,
+                                      iCase=iCase, iWT=iWT)
+
+
+def _calc_qtf_slender_body(fowt, waveHeadInd, Xi0=None, verbose=False, iCase=None, iWT=None):
     nw2 = len(fowt.w1_2nd)
     if Xi0 is None:
         Xi0 = np.zeros([6, fowt.nw], dtype=complex)

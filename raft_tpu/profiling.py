@@ -1,0 +1,67 @@
+"""Structured per-phase timing (SURVEY.md §5: the reference's only
+instrumentation is one ad-hoc QTF timer, raft_model.py:980-984).
+
+Usage::
+
+    from raft_tpu import profiling
+    with profiling.phase("statics"):
+        ...
+    profiling.report()        # dict of {phase: seconds}
+    profiling.summary()       # printable table, reset with reset()
+
+Timers nest (inner phases are recorded under "outer/inner") and are
+process-global, cheap (perf_counter), and inert unless read — analysis
+drivers wrap their stages unconditionally.  For kernel-level profiling
+use ``jax.profiler.trace`` around a phase; this module deliberately
+stays dependency-free so it also times host-side stages (YAML parsing,
+mesh generation, table builds) the JAX profiler cannot see.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from collections import defaultdict
+
+_times: dict[str, float] = defaultdict(float)
+_counts: dict[str, int] = defaultdict(int)
+_stack: list[str] = []
+
+
+@contextlib.contextmanager
+def phase(name: str):
+    """Accumulate wall time under ``name`` (nested -> 'outer/inner')."""
+    full = "/".join(_stack + [name])
+    _stack.append(name)
+    t0 = time.perf_counter()
+    try:
+        yield
+    finally:
+        _stack.pop()
+        _times[full] += time.perf_counter() - t0
+        _counts[full] += 1
+
+
+def report() -> dict[str, float]:
+    """Accumulated seconds per phase."""
+    return dict(_times)
+
+
+def counts() -> dict[str, int]:
+    return dict(_counts)
+
+
+def reset() -> None:
+    _times.clear()
+    _counts.clear()
+
+
+def summary() -> str:
+    """Aligned table of phases, call counts, and accumulated seconds."""
+    if not _times:
+        return "(no phases recorded)"
+    width = max(len(k) for k in _times)
+    lines = [f"{'phase':<{width}}  {'calls':>6}  {'seconds':>9}"]
+    for k in sorted(_times, key=_times.get, reverse=True):
+        lines.append(f"{k:<{width}}  {_counts[k]:>6}  {_times[k]:>9.3f}")
+    return "\n".join(lines)

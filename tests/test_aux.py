@@ -266,3 +266,28 @@ def test_omdao_ghost_lfill_regrid():
         inputs2, {}, modeling_opts={"potModMaster": 1}, turbine_opts={},
         mooring_opts={}, member_opts={"nmembers": 1}, analysis_opts={})
     assert design2["platform"]["members"][0]["l_fill"] == [1.0, 2.0, 3.0, 4.0]
+
+
+def test_phase_profiling():
+    """Structured per-phase timing (SURVEY.md §5 aux subsystem)."""
+    from raft_tpu import profiling
+
+    profiling.reset()
+    with profiling.phase("outer"):
+        with profiling.phase("inner"):
+            pass
+    rep = profiling.report()
+    assert set(rep) == {"outer", "outer/inner"}
+    assert rep["outer"] >= rep["outer/inner"] >= 0.0
+    assert profiling.counts()["outer"] == 1
+    assert "outer/inner" in profiling.summary()
+    profiling.reset()
+
+    import raft_tpu
+
+    model = raft_tpu.Model(demo_spar(nw_freqs=(0.05, 0.4)))
+    model.analyzeCases()
+    rep = profiling.report()
+    for key in ("statics", "BEM", "solveStatics", "solveDynamics"):
+        assert key in rep, key
+    profiling.reset()
