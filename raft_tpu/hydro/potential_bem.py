@@ -25,9 +25,15 @@ switches to the John finite-depth kernel from
 tables, an explicit bottom-image Rankine term, and the finite-depth
 incident-wave profile in the Haskind excitation.
 
-Remaining limitations (documented, graceful): no forward speed; no
-irregular-frequency removal (accuracy degrades near interior
-resonances, e.g. ka >~ 2.5 for a hemisphere).
+Remaining limitations (documented, graceful): no forward speed.
+Near interior (irregular) frequencies — ka >~ 2.5 for a hemisphere —
+accuracy degrades (energy-identity violations up to ~25% right at a
+resonance); the experimental ``irr_removal=True`` option adds an
+interior-waterplane source lid with phi = 0 Dirichlet rows (extended
+boundary condition), which suppresses the resonance spikes (surge at
+ka = 4: -24% -> -9%) at the cost of a few percent broadband accuracy
+from the lid panels' waterplane self-terms.  A Burton-Miller combined
+source-dipole layer would remove them cleanly and is future work.
 """
 
 from __future__ import annotations
@@ -93,14 +99,13 @@ class PanelBEM:
     """Radiation/diffraction solver for one panel mesh."""
 
     def __init__(self, mesh, rho=1025.0, g=9.81, ref_point=(0.0, 0.0, 0.0),
-                 depth=None):
+                 depth=None, irr_removal=False):
         self.rho = rho
         self.g = g
         self.depth = None if (depth is None or not np.isfinite(depth)) else float(depth)
         areas, centroids, normals = mesh.areas_centroids_normals()
-        # drop degenerate panels and waterplane lids (centroid at z=0:
-        # not a wetted surface, and its free-surface image coincides
-        # with itself, making the image term singular)
+        # wetted body panels exclude degenerate panels and waterplane lids
+        # (centroid at z=0 is not a wetted surface)
         keep = (areas > 1e-8) & (centroids[:, 2] < -1e-6)
         self.areas = areas[keep]
         self.centroids = centroids[keep]
@@ -109,23 +114,56 @@ class PanelBEM:
         self.n = len(self.areas)
         self.ref = np.asarray(ref_point, dtype=float)
 
-        S0, D0 = _rankine_matrices(self.centroids, self.areas, self.normals)
+        # irregular-frequency removal (extended boundary condition): the
+        # z=0 panels the mesher emits inside the waterline become an
+        # interior-free-surface lid carrying extra sources and Dirichlet
+        # collocation rows phi = 0 — the interior problem then has no
+        # eigenfrequencies (Ohmatsu / Lee-Sclavounos; HAMS's IRR option)
+        # only true z=0 waterplane panels qualify; anything higher is an
+        # above-water panel the solver ignores (never a lid)
+        lid = (areas > 1e-8) & (np.abs(centroids[:, 2]) <= 1e-6)
+        if irr_removal and np.any(lid):
+            lidC = centroids[lid].copy()
+            lidC[:, 2] = 0.0
+            lidA = areas[lid]
+            self.nl = len(lidA)
+        else:
+            self.nl = 0
+
+        if self.nl:
+            Ce = np.vstack([self.centroids, lidC])
+            Ae = np.concatenate([self.areas, lidA])
+            Nrm_e = np.vstack([self.normals,
+                               np.tile([0.0, 0.0, 1.0], (self.nl, 1))])
+        else:
+            Ce, Ae, Nrm_e = self.centroids, self.areas, self.normals
+        self.ne = self.n + self.nl
+        self._Ce = Ce
+
+        S0, D0 = _rankine_matrices(Ce, Ae, Nrm_e)
         self.S0 = jnp.asarray(S0)
         self.D0 = jnp.asarray(D0)
 
-        # geometry pieces reused per frequency
-        C = self.centroids
+        # geometry pieces reused per frequency (assembly set = body + lid;
+        # physics integrals slice the body block [:self.n])
+        C = Ce
         dxy = C[:, None, :2] - C[None, :, :2]
         self.Rh = jnp.asarray(np.linalg.norm(dxy, axis=-1))
         self.zz = jnp.asarray(C[:, None, 2] + C[None, :, 2])
         eps = 1e-9
         self.e_xy = jnp.asarray(dxy / (np.linalg.norm(dxy, axis=-1)[..., None] + eps))
-        self.jA = jnp.asarray(self.areas)
-        self.jN = jnp.asarray(self.normals)
-        self.jC = jnp.asarray(C)
+        self.jA = jnp.asarray(Ae)
+        self.jN = jnp.asarray(Nrm_e)
+        self.jC_b = jnp.asarray(self.centroids)  # body-only (physics integrals)
+        # panel-scale floor for the wave-part lookups: the Green function's
+        # log singularity at (R, z+zeta) -> 0 (waterline/lid self terms)
+        # must enter as its panel average, i.e. its value at ~0.38*sqrt(A)
+        # (the <ln r> average over a square panel), not at the clamped
+        # table corner
+        self._a_floor = jnp.asarray(0.38 * np.sqrt(Ae))
 
-        # rigid-body mode normal velocities n_k at each panel (about ref)
-        lever = C - self.ref[None, :]
+        # rigid-body mode normal velocities n_k at each body panel (about ref)
+        lever = self.centroids - self.ref[None, :]
         modes = np.zeros((6, self.n))
         modes[0:3] = self.normals.T
         modes[3:6] = np.cross(lever, self.normals).T
@@ -142,14 +180,14 @@ class PanelBEM:
             # belongs to the John kernel and is only added on the
             # finite-depth branch (the deep kernel's G has no bottom image)
             h = self.depth
-            Cim = self.centroids * np.array([1.0, 1.0, -1.0]) \
+            Cim = Ce * np.array([1.0, 1.0, -1.0]) \
                 + np.array([0.0, 0.0, -2.0 * h])
-            d2 = self.centroids[:, None, :] - Cim[None, :, :]
+            d2 = Ce[:, None, :] - Cim[None, :, :]
             r2sq = np.sum(d2**2, axis=-1)
-            eps = self.areas[None, :] / SELF_TERM_COEF**2
-            S_b = self.areas[None, :] / np.sqrt(r2sq + eps)
-            G_b = -d2 / (r2sq + eps)[..., None] ** 1.5 * self.areas[None, :, None]
-            D_b = np.einsum("ijk,ik->ij", G_b, self.normals)
+            eps = Ae[None, :] / SELF_TERM_COEF**2
+            S_b = Ae[None, :] / np.sqrt(r2sq + eps)
+            G_b = -d2 / (r2sq + eps)[..., None] ** 1.5 * Ae[None, :, None]
+            D_b = np.einsum("ijk,ik->ij", G_b, Nrm_e)
             self.S_bot = jnp.asarray(S_b)
             self.D_bot = jnp.asarray(D_b)
 
@@ -179,8 +217,9 @@ class PanelBEM:
     # ------------------------------------------------------------------
 
     def _wave_matrices(self, k):
-        """Frequency-dependent wave-part S_w, D_w (complex [N,N])."""
-        A = k * self.Rh
+        """Frequency-dependent wave-part S_w, D_w (complex [ne, ne],
+        over the body + lid assembly set)."""
+        A = k * jnp.maximum(self.Rh, self._a_floor[None, :])
         V = k * self.zz
 
         I0 = self.table.pv(A, V)
@@ -217,7 +256,7 @@ class PanelBEM:
         from .greens_fd import lookup_f1, lookup_f2
 
         h = self.depth
-        R = self.Rh
+        R = jnp.maximum(self.Rh, self._a_floor[None, :])
         u = self.zz
         w = self.zdiff
 
@@ -259,20 +298,32 @@ class PanelBEM:
         B_out = np.zeros([6, 6, nw])
         X_out = np.zeros([len(heads), 6, nw], dtype=complex)
 
+        nb = self.n
+        jA_b = self.jA[:nb]
+        jN_b = self.jN[:nb]
+
         def radiate_and_excite(wi, ki, S_w, D_w, S0, D0, prof, dprof):
-            S = (S0 + S_w).astype(jnp.complex128)
+            S = (S0 + S_w).astype(jnp.complex128)   # [ne, ne]
             D = (D0 + D_w).astype(jnp.complex128)
             # Hess & Smith with outward normals (fluid side): the flat-
-            # panel self gradient carries only the -2*pi jump
-            lhs = -2.0 * jnp.pi * jnp.eye(self.n, dtype=jnp.complex128) + D
-            # radiation: unit-velocity normal BCs for the 6 modes
-            sigma_r = jnp.linalg.solve(lhs, self.modes.T.astype(jnp.complex128))
-            phi_r = S @ sigma_r  # [N, 6] potential per unit normal VELOCITY
+            # panel self gradient carries only the -2*pi jump.  Body rows
+            # impose the Neumann BC; lid rows (irregular-frequency
+            # removal) impose phi = 0 on the interior waterplane.
+            lhs_body = D[:nb, :].at[:, :nb].add(
+                -2.0 * jnp.pi * jnp.eye(nb, dtype=jnp.complex128))
+            if self.nl:
+                lhs = jnp.concatenate([lhs_body, S[nb:, :]], axis=0)
+            else:
+                lhs = lhs_body
+            rhs = jnp.zeros((self.ne, 6), dtype=jnp.complex128)
+            rhs = rhs.at[:nb].set(self.modes.T.astype(jnp.complex128))
+            sigma_r = jnp.linalg.solve(lhs, rhs)
+            phi_r = S[:nb, :] @ sigma_r  # [Nb, 6] potential per unit normal VELOCITY
             # F_mj = -i w rho ∬ phi_j n_m dS ;  F = (i w A - B) v
-            Fr = -1j * wi * self.rho * jnp.einsum("mn,nj,n->mj", self.modes, phi_r, self.jA)
+            Fr = -1j * wi * self.rho * jnp.einsum("mn,nj,n->mj", self.modes, phi_r, jA_b)
 
             def incident(bh):
-                kx = ki * (self.jC[:, 0] * jnp.cos(bh) + self.jC[:, 1] * jnp.sin(bh))
+                kx = ki * (self.jC_b[:, 0] * jnp.cos(bh) + self.jC_b[:, 1] * jnp.sin(bh))
                 phase = jnp.exp(-1j * kx)
                 phi0 = (self.g / wi) * prof * phase
                 grad = jnp.stack([
@@ -280,11 +331,11 @@ class PanelBEM:
                     -1j * ki * jnp.sin(bh) * phi0,
                     (self.g / wi) * dprof * phase,
                 ], axis=-1)
-                dphi0_dn = jnp.einsum("ni,ni->n", grad, self.jN)
+                dphi0_dn = jnp.einsum("ni,ni->n", grad, jN_b)
                 # Haskind: X_m = -i w rho ∬ (phi0 n_m - phi_r_m dphi0/dn) dS
                 Xm = -1j * wi * self.rho * (
-                    jnp.einsum("mn,n,n->m", self.modes, phi0, self.jA)
-                    - jnp.einsum("nm,n,n->m", phi_r, dphi0_dn, self.jA)
+                    jnp.einsum("mn,n,n->m", self.modes, phi0, jA_b)
+                    - jnp.einsum("nm,n,n->m", phi_r, dphi0_dn, jA_b)
                 )
                 return Xm
 
@@ -333,7 +384,7 @@ class PanelBEM:
                 tab = self._fd_table(wi**2 / self.g)
                 self._fd_Rmax = tab.R_max
                 rc = residue_coef(tab.K, self.depth, tab.k)
-                z = np.asarray(self.centroids[:, 2])
+                z = np.asarray(self._Ce[:, 2])  # body + lid assembly set
                 arg = np.minimum(tab.k * (z + self.depth), 300.0)
                 res_ch = jnp.asarray(np.sqrt(rc) * np.cosh(arg))
                 res_sh = jnp.asarray(np.sqrt(rc) * np.sinh(arg))

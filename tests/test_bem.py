@@ -200,3 +200,25 @@ def test_finite_depth_energy_and_deep_limit():
     A3, _, _ = bem3.solve(wd, k3, headings_deg=[0.0])
     assert len(bem3._fd_tables) == 0
     assert A3[2, 2, 0] == pytest.approx(Ad[2, 2, 0], rel=0.01)
+
+
+def test_irr_removal_suppresses_interior_resonance():
+    """The experimental interior-lid option (extended boundary condition)
+    damps the surge energy-identity violation at the hemisphere's
+    interior resonance near ka = 4, while staying sane elsewhere."""
+    mesh = hemi_mesh()
+    ka = np.array([4.0])
+    w = np.sqrt(G * ka)
+
+    def surge_identity_err(bem):
+        A, B, X = bem.solve(w, ka, headings_deg=[0.0])
+        B11_energy = ka[0] * w[0] * abs(X[0, 0, 0]) ** 2 / (4 * RHO * G**2)
+        return abs(B[0, 0, 0] / B11_energy - 1.0), A
+
+    err_plain, _ = surge_identity_err(PanelBEM(mesh, rho=RHO, g=G))
+    bem_irr = PanelBEM(mesh, rho=RHO, g=G, irr_removal=True)
+    assert bem_irr.nl > 0  # the mesher's z=0 cap became the lid
+    err_irr, A_irr = surge_identity_err(bem_irr)
+    assert err_plain > 0.15          # the resonance is visible without the lid
+    assert err_irr < 0.6 * err_plain  # and substantially suppressed with it
+    assert 0.3 < A_irr[2, 2, 0] / (RHO * HEMI_V) < 0.6  # physics still sane
