@@ -46,7 +46,23 @@ def set_in_design(design, path, value):
         node[last] = value
 
 
-def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0):
+def _compile_variant(base_design, axes, combo, device):
+    from .parallel.case_solve import design_params
+
+    design = copy.deepcopy(base_design)
+    for (path, _), val in zip(axes, combo):
+        set_in_design(design, path, val)
+    model = Model(design)
+    fowt = model.fowtList[0]
+    fowt.setPosition(np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0]))
+    fowt.calcStatics()
+    fowt.calcHydroConstants()
+    p, s = design_params(fowt, include_aero=False, device=device)
+    return p, s, fowt
+
+
+def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
+          checkpoint=None, chunk_size=256):
     """Run a factorial design sweep.
 
     Parameters
@@ -57,59 +73,101 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0):
         Design-variable axes; full factorial product is evaluated.
     sea_states : list of (Hs, Tp) or (Hs, Tp, heading_deg)
         Wave cases solved (batched) for every design variant.
+    checkpoint : str, optional
+        Path to an .npz progress file.  Designs execute in chunks of
+        ``chunk_size``; after each chunk the partial results are saved
+        (atomically), and a re-run of the same sweep resumes from the
+        first unfinished chunk — the sweep-level resumability SURVEY.md
+        §5 calls for (the reference's serial sweep restarts from scratch).
+        A checkpoint from a *different* sweep signature is ignored.
 
     Returns
     -------
     dict with 'grid' (the factorial list of value tuples) and
     'motion_std' [n_designs, n_cases, 6] motion standard deviations.
     """
-    from .parallel.case_solve import design_params, make_parametric_solver
+    import hashlib
+    import os
+
+    from .parallel.case_solve import make_parametric_solver
 
     combos = list(itertools.product(*[v for _, v in axes]))
     n_designs = len(combos)
-    grid = []
+    n_cases = len(sea_states)
+    grid = combos
 
-    # host pass: compile every design variant into a params pytree
-    # (identical topology -> identical shapes -> ONE jitted executable)
-    params_list = []
-    static = None
-    template = None
-    for ic, combo in enumerate(combos):
-        design = copy.deepcopy(base_design)
-        for (path, _), val in zip(axes, combo):
-            set_in_design(design, path, val)
-        grid.append(combo)
+    # checkpoint identity covers the whole sweep definition: base design,
+    # axis PATHS (a callable axis repr includes a per-process address, so
+    # such sweeps conservatively never resume), exact value bytes (repr
+    # would elide large arrays), sea states, and the iteration count
+    h = hashlib.sha256()
+    from .io_utils import clean_raft_dict
+    h.update(repr(clean_raft_dict(base_design)).encode())
+    h.update(repr([str(path) for path, _ in axes]).encode())
+    for combo in combos:
+        for v in combo:
+            h.update(np.asarray(v, dtype=float).tobytes())
+    for s in sea_states:
+        h.update(np.asarray(s, dtype=float).tobytes())
+    h.update(str(n_iter).encode())
+    sig = h.hexdigest()
 
-        model = Model(design)
-        fowt = model.fowtList[0]
-        fowt.setPosition(np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0]))
-        fowt.calcStatics()
-        fowt.calcHydroConstants()
-        p, s = design_params(fowt, include_aero=False, device=device)
-        params_list.append(p)
-        static = s
-        template = fowt
-        if display:
-            print(f"compiled design {ic+1}/{n_designs}: {combo}")
+    results = np.full((n_designs, n_cases, 6), np.nan)
+    done = np.zeros(n_designs, dtype=bool)
+    if checkpoint and os.path.exists(checkpoint):
+        with np.load(checkpoint, allow_pickle=False) as dat:
+            if str(dat["sig"]) == sig and dat["motion_std"].shape == results.shape:
+                results = np.array(dat["motion_std"])
+                done = np.array(dat["done"])
+                if display:
+                    print(f"sweep resume: {int(done.sum())}/{n_designs} designs already done")
 
-    params_stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+    batched = None
 
-    solve_p = make_parametric_solver(static, n_iter=n_iter)
-    # vmap axes: designs (params), then cases (waves) — one executable
-    batched = jax.jit(jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
-                               in_axes=(0, None, None)))
+    for start in range(0, n_designs, chunk_size):
+        stop = min(start + chunk_size, n_designs)
+        if done[start:stop].all():
+            continue
 
-    w = jnp.asarray(template.w)
-    zetas, betas = [], []
-    for ss in sea_states:
-        Hs, Tp = ss[0], ss[1]
-        beta = np.radians(ss[2]) if len(ss) > 2 else 0.0
-        S = waves.jonswap(w, Hs, Tp)
-        zetas.append(jnp.sqrt(2.0 * S * template.dw) + 0j)
-        betas.append(jnp.array([beta]))
-    zetas = jnp.stack(zetas)[:, None, :]
-    betas = jnp.stack(betas)
+        params_list = []
+        static = template = None
+        for ic in range(start, stop):
+            p, static, template = _compile_variant(base_design, axes, combos[ic], device)
+            params_list.append(p)
+            if display:
+                print(f"compiled design {ic+1}/{n_designs}: {combos[ic]}")
+        # pad a short final chunk by repeating the last design so every
+        # chunk shares one leading shape (a second XLA compile would cost
+        # more than the padded rows; padded results are discarded)
+        n_real = len(params_list)
+        if n_designs > chunk_size:
+            params_list += [params_list[-1]] * (chunk_size - n_real)
 
-    Xi = batched(params_stacked, zetas, betas)  # [ndesign, ncase, 1, 6, nw]
-    std = jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1))  # [nd, nc, 6]
-    return {"grid": grid, "motion_std": np.asarray(std)}
+        if batched is None:
+            solve_p = make_parametric_solver(static, n_iter=n_iter)
+            # vmap axes: designs (params), then cases (waves) — one executable
+            batched = jax.jit(jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
+                                       in_axes=(0, None, None)))
+            w = jnp.asarray(template.w)
+            zl, bl = [], []
+            for ss in sea_states:
+                Hs, Tp = ss[0], ss[1]
+                beta = np.radians(ss[2]) if len(ss) > 2 else 0.0
+                S = waves.jonswap(w, Hs, Tp)
+                zl.append(jnp.sqrt(2.0 * S * template.dw) + 0j)
+                bl.append(jnp.array([beta]))
+            zetas = jnp.stack(zl)[:, None, :]
+            betas = jnp.stack(bl)
+
+        params_stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
+        Xi = batched(params_stacked, zetas, betas)  # [chunk, ncase, 1, 6, nw]
+        results[start:stop] = np.asarray(
+            jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1)))[:n_real]
+        done[start:stop] = True
+
+        if checkpoint:
+            tmp = f"{checkpoint}.{os.getpid()}.tmp.npz"  # .npz: savez keeps the name
+            np.savez(tmp, sig=sig, motion_std=results, done=done)
+            os.replace(tmp, checkpoint)
+
+    return {"grid": grid, "motion_std": results}
