@@ -975,6 +975,41 @@ class FOWT:
         C[5, 5] += self.yawstiff
         return C + self.C_struc + self.C_hydro
 
+    def plot(self, ax=None, color="k", nodes=False, **kwargs):
+        """3-D geometry plot of this FOWT's members and mooring lines
+        (raft_fowt.py:2111+, light version)."""
+        import matplotlib.pyplot as plt
+
+        if ax is None:
+            fig = plt.figure(figsize=(7, 7))
+            ax = fig.add_subplot(projection="3d")
+        for pose in self._poses:
+            r = np.asarray(pose.r)
+            ax.plot(r[:, 0], r[:, 1], r[:, 2], color=color, **kwargs)
+            if nodes:
+                ax.scatter(r[:, 0], r[:, 1], r[:, 2], s=4, color=color)
+        if self.ms is not None:
+            pos = np.asarray(moorsys.point_positions(
+                self.ms, self.ms.params, jnp.asarray(self.r6)))
+            for iA, iB in zip(self.ms.line_iA, self.ms.line_iB):
+                ax.plot(*np.stack([pos[iA], pos[iB]]).T, color="b", lw=0.8)
+        ax.set_xlabel("x (m)"); ax.set_ylabel("y (m)"); ax.set_zlabel("z (m)")
+        return ax
+
+    def plot2d(self, ax=None, plane="xz", color="k", **kwargs):
+        """2-D projection of this FOWT's geometry (raft_fowt.py plot2d)."""
+        import matplotlib.pyplot as plt
+
+        ix = 0 if plane[0] == "x" else 1
+        if ax is None:
+            _, ax = plt.subplots(figsize=(6, 6))
+        for pose in self._poses:
+            r = np.asarray(pose.r)
+            ax.plot(r[:, ix], r[:, 2], color=color, **kwargs)
+        ax.set_xlabel(f"{plane[0]} (m)"); ax.set_ylabel("z (m)")
+        ax.set_aspect("equal", adjustable="datalim")
+        return ax
+
     def solveEigen(self, display=0):
         """Natural frequencies/modes of this FOWT alone (raft_fowt.py:902-969)."""
         M_tot = self.M_struc + self.A_hydro_morison

@@ -33,6 +33,17 @@ from .fowt import FOWT, _sorted_eigen
 TwoPi = 2.0 * np.pi
 
 
+def _plot_moor_segments(ax, pos, line_iA, line_iB, ix=None, color="b", lw=0.8):
+    """Draw mooring line segments; 3-D axes when ix is None, else the
+    (ix, z) projection."""
+    for iA, iB in zip(line_iA, line_iB):
+        seg = np.stack([pos[iA], pos[iB]])
+        if ix is None:
+            ax.plot(*seg.T, color=color, lw=lw)
+        else:
+            ax.plot(seg[:, ix], seg[:, 2], color=color, lw=lw)
+
+
 class Model:
     """Frequency-domain model of one or more floating turbines."""
 
@@ -615,17 +626,148 @@ class Model:
             if fowt.ms is not None:
                 pos = np.asarray(moorsys.point_positions(
                     fowt.ms, fowt.ms.params, jnp.asarray(fowt.r6)))
-                for iA, iB in zip(fowt.ms.line_iA, fowt.ms.line_iB):
-                    ax.plot(*np.stack([pos[iA], pos[iB]]).T, color="b", lw=0.8)
+                _plot_moor_segments(ax, pos, fowt.ms.line_iA, fowt.ms.line_iB,
+                                    color="b")
         if self.ms is not None:  # array-level shared mooring (farm)
             pos = np.asarray(moorsys.point_positions(
                 self.ms, self.ms.params, jnp.asarray(self._fowt_positions())))
-            for iA, iB in zip(self.ms.line_iA, self.ms.line_iB):
-                ax.plot(*np.stack([pos[iA], pos[iB]]).T, color="g", lw=0.8)
+            _plot_moor_segments(ax, pos, self.ms.line_iA, self.ms.line_iB,
+                                color="g")
         ax.set_xlabel("x (m)")
         ax.set_ylabel("y (m)")
         ax.set_zlabel("z (m)")
         return ax
+
+    def plot2d(self, ax=None, plane="xz", color="k", **kwargs):
+        """2-D projection of the geometry (raft_model.py plot2d): members
+        and mooring lines (incl. array-level shared mooring) projected
+        onto the given plane ('xz' or 'yz')."""
+        import matplotlib.pyplot as plt
+
+        ix = 0 if plane[0] == "x" else 1
+        if ax is None:
+            _, ax = plt.subplots(figsize=(7, 6))
+        for fowt in self.fowtList:
+            fowt.plot2d(ax=ax, plane=plane, color=color, **kwargs)
+            if fowt.ms is not None:
+                pos = np.asarray(moorsys.point_positions(
+                    fowt.ms, fowt.ms.params, jnp.asarray(fowt.r6)))
+                _plot_moor_segments(ax, pos, fowt.ms.line_iA, fowt.ms.line_iB,
+                                    ix=ix, color="b")
+        if self.ms is not None:  # array-level shared mooring (farm)
+            pos = np.asarray(moorsys.point_positions(
+                self.ms, self.ms.params, jnp.asarray(self._fowt_positions())))
+            _plot_moor_segments(ax, pos, self.ms.line_iA, self.ms.line_iB,
+                                ix=ix, color="g")
+        ax.set_xlabel(f"{plane[0]} (m)")
+        ax.set_ylabel("z (m)")
+        ax.set_aspect("equal", adjustable="datalim")
+        return ax
+
+    def plotResponses_extended(self):
+        """Extended PSD figure incl. rotor channels where available
+        (raft_model.py:1231+); falls back to the standard panel set."""
+        import matplotlib.pyplot as plt
+
+        fig, ax = self.plotResponses()
+        nCases = len(self.results.get("case_metrics", {}))
+        if nCases == 0:
+            return fig, ax
+        for i in range(self.nFOWT):
+            m0 = self.results["case_metrics"][0][i]
+            if "omega_PSD" not in m0:
+                continue
+            fig2, ax2 = plt.subplots(3, 1, sharex=True, figsize=(6, 5))
+            for iCase in range(nCases):
+                m = self.results["case_metrics"][iCase][i]
+                ax2[0].plot(self.w / TwoPi, np.atleast_2d(m["omega_PSD"].T)[0])
+                ax2[1].plot(self.w / TwoPi, np.atleast_2d(m["torque_PSD"].T)[0])
+                ax2[2].plot(self.w / TwoPi, np.atleast_2d(m["bPitch_PSD"].T)[0])
+            for a, lab in zip(ax2, ("rotor speed", "torque", "blade pitch")):
+                a.set_ylabel(lab)
+            ax2[-1].set_xlabel("frequency (Hz)")
+        return fig, ax
+
+    def addFOWT(self, fowt, xy0=(0, 0)):
+        """Add an already-constructed FOWT to the model (raft_model.py:175);
+        the FOWT's reference position follows xy0 so statics and wake
+        models see it at the new location."""
+        fowt.x_ref, fowt.y_ref = float(xy0[0]), float(xy0[1])
+        self.fowtList.append(fowt)
+        self.coords.append(list(xy0))
+        self.nFOWT = len(self.fowtList)
+        self.nDOF += 6
+
+    # ----- FLORIS-style farm coupling (raft_model.py:1674-2022): the
+    # wake model itself is raft_tpu.farm's Gaussian model -----
+
+    def powerThrustCurve(self, uhubs, nfowt=0, nrotor=0, heading=0.0):
+        from .. import farm
+
+        return farm.power_thrust_curve(self, uhubs, nfowt=nfowt,
+                                       nrotor=nrotor, heading=heading)
+
+    def florisCoupling(self, D, ct_table_U, ct_table_CT, k_star=0.04):
+        from .. import farm
+
+        self.wake_farm = farm.GaussianWakeFarm(D, ct_table_U, ct_table_CT,
+                                               k_star=k_star)
+        return self.wake_farm
+
+    def florisFindEquilibrium(self, case, max_iter=20, tol=0.1, display=0):
+        from .. import farm
+
+        return farm.find_equilibrium(self, case, self.wake_farm,
+                                     max_iter=max_iter, tol=tol, display=display)
+
+    def florisCalcAEP(self, wind_rose, power_curve, hours=8760.0):
+        from .. import farm
+
+        return farm.calc_aep(self, self.wake_farm, wind_rose, power_curve,
+                             hours=hours)
+
+    def adjustWISDEM(self, old_wisdem_file, new_wisdem_file):
+        """Write RAFT-trimmed ballast fill levels back into a WISDEM
+        geometry YAML (raft_model.py:1627-1672): match WISDEM floating-
+        platform members to RAFT members by bottom-joint elevation and
+        first diameter, then set the first ballast volume from the RAFT
+        member's l_fill."""
+        import yaml as _yaml
+
+        with open(old_wisdem_file, "r", encoding="utf-8") as f:
+            wisdem_design = _yaml.safe_load(f)
+
+        fowt = self.fowtList[0]
+        members_w = wisdem_design["components"]["floating_platform"]["members"]
+        joints = wisdem_design["components"]["floating_platform"]["joints"]
+        for wmem in members_w:
+            if "ballasts" not in wmem.get("internal_structure", {}):
+                continue
+            from ..structure.member import axis_length
+
+            for i, cm in enumerate(fowt.memberList):
+                pose = fowt._poses[i]
+                rA = np.asarray(pose.rA)
+                d0 = float(np.ravel(np.asarray(cm.geom.d))[0])
+                t0 = float(np.ravel(np.asarray(cm.geom.t))[0])
+                L = float(np.asarray(axis_length(cm.geom)))
+                lf0 = float(np.ravel(np.asarray(cm.geom.l_fill_frac))[0]) * L
+                matched = False
+                for joint in joints:
+                    if wmem["joint1"] == joint["name"]:
+                        same_z = str(joint["location"][2])[0:5] == str(rA[2])[0:5]
+                        same_d = wmem["outer_shape"]["outer_diameter"]["values"][0] == d0
+                        if same_z and same_d:
+                            area = np.pi * ((d0 - 2 * t0) / 2) ** 2
+                            wmem["internal_structure"]["ballasts"][0]["volume"] = \
+                                float(area * lf0)
+                            matched = True
+                        break
+                if matched:
+                    break
+        with open(new_wisdem_file, "w", encoding="utf-8") as f:
+            _yaml.safe_dump(wisdem_design, f, sort_keys=False)
+        return wisdem_design
 
     def preprocess_HAMS(self, dw=0, wMax=0, dz=0, da=0, meshDir="BEM"):
         """Export panel meshes (and BEM coefficients when solved) for

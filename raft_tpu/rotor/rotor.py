@@ -446,6 +446,36 @@ class Rotor:
     # pose
     # ------------------------------------------------------------------
 
+    def IECKaimal(self, case, current=False):
+        """Rotor-averaged Kaimal turbulence spectrum at the model
+        frequencies (raft_rotor.py:1125-1223); thin method alias of
+        :func:`raft_tpu.rotor.wind.kaimal_rotor_spectra`."""
+        from .wind import kaimal_rotor_spectra
+
+        speed = case["current_speed" if current else "wind_speed"]
+        turb = case.get("current_turbulence" if current else "turbulence", 0)
+        if not turb or not speed:  # steady / no-flow case: no spectrum
+            nw = len(np.asarray(self.w))
+            return (np.zeros(nw), np.zeros(nw), np.zeros(nw), np.zeros(nw))
+        return kaimal_rotor_spectra(self.w, speed, turb, self.r3[2], self.R_rot)
+
+    def plot(self, ax=None, color="k", azimuths=None, **kwargs):
+        """Sketch the rotor: hub marker plus blade axis lines at each
+        azimuth (raft_rotor.py:1008, light version)."""
+        import matplotlib.pyplot as plt
+
+        if ax is None:
+            fig = plt.figure(figsize=(6, 6))
+            ax = fig.add_subplot(projection="3d")
+        hub = np.asarray(self.r3, dtype=float)
+        ax.scatter(*hub, color=color, s=20)
+        azimuths = azimuths if azimuths is not None else np.arange(0.0, 360.0, 120.0)
+        R = float(self.R_rot)
+        for az in np.radians(np.asarray(azimuths, dtype=float)):
+            tip = hub + R * np.array([0.0, np.sin(az), np.cos(az)])
+            ax.plot(*np.stack([hub, tip]).T, color=color, **kwargs)
+        return ax
+
     def setPosition(self, r6=None):
         """Update rotor pose from the FOWT pose (raft_rotor.py:376-409)."""
         if r6 is None:

@@ -96,24 +96,29 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
     n_cases = len(sea_states)
     grid = combos
 
-    # checkpoint identity covers the whole sweep definition: base design,
-    # axis PATHS (a callable axis repr includes a per-process address, so
-    # such sweeps conservatively never resume), exact value bytes (repr
-    # would elide large arrays), sea states, and the iteration count
-    h = hashlib.sha256()
-    from .io_utils import clean_raft_dict
-    h.update(repr(clean_raft_dict(base_design)).encode())
-    h.update(repr([str(path) for path, _ in axes]).encode())
-    for combo in combos:
-        for v in combo:
-            h.update(np.asarray(v, dtype=float).tobytes())
-    for s in sea_states:
-        h.update(np.asarray(s, dtype=float).tobytes())
-    h.update(str(n_iter).encode())
-    sig = h.hexdigest()
-
     results = np.full((n_designs, n_cases, 6), np.nan)
     done = np.zeros(n_designs, dtype=bool)
+    sig = None
+    if checkpoint:
+        # checkpoint identity covers the whole sweep definition: base
+        # design, axis PATHS (a callable axis repr includes a per-process
+        # address, so such sweeps conservatively never resume), exact
+        # value bytes (repr would elide large arrays; non-numeric values
+        # hash via repr), sea states, and the iteration count
+        h = hashlib.sha256()
+        from .io_utils import clean_raft_dict
+        h.update(repr(clean_raft_dict(base_design)).encode())
+        h.update(repr([str(path) for path, _ in axes]).encode())
+        for combo in combos:
+            for v in combo:
+                try:
+                    h.update(np.asarray(v, dtype=float).tobytes())
+                except (TypeError, ValueError):
+                    h.update(repr(v).encode())
+        for s in sea_states:
+            h.update(np.asarray(s, dtype=float).tobytes())
+        h.update(str(n_iter).encode())
+        sig = h.hexdigest()
     if checkpoint and os.path.exists(checkpoint):
         with np.load(checkpoint, allow_pickle=False) as dat:
             if str(dat["sig"]) == sig and dat["motion_std"].shape == results.shape:

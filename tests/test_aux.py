@@ -336,3 +336,34 @@ def test_sweep_checkpoint_resume(tmp_path):
         sweep_mod._compile_variant = orig
     assert len(calls) == 3  # recomputed all designs
     assert out3["motion_std"].shape == (3, 1, 6)
+
+
+def test_reference_api_surface(tmp_path):
+    """Reference-named convenience APIs exist and run: plotting
+    (Model/FOWT/Rotor), addFOWT, floris* wrappers, IECKaimal alias."""
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import raft_tpu
+
+    model = raft_tpu.Model(demo_spar(nw_freqs=(0.05, 0.4)))
+    model.analyzeCases()
+    assert model.plot() is not None
+    assert model.plot2d() is not None
+    model.plotResponses_extended()
+    fowt = model.fowtList[0]
+    assert fowt.plot() is not None and fowt.plot2d() is not None
+    rotor = fowt.rotorList[0]
+    assert rotor.plot() is not None
+    case = dict(zip(model.design["cases"]["keys"], model.design["cases"]["data"][0]))
+    case["wind_speed"], case["turbulence"] = 10.0, 0.14
+    U, V, W, Rot = rotor.IECKaimal(case)
+    assert np.max(np.asarray(U)) > 0  # Kaimal PSD is live
+    n0 = model.nFOWT
+    model.addFOWT(fowt, (1600, 0))
+    assert model.nFOWT == n0 + 1
+
+    # floris-style wrappers exist and delegate to the farm wake layer
+    for name in ("powerThrustCurve", "florisCoupling",
+                 "florisFindEquilibrium", "florisCalcAEP"):
+        assert callable(getattr(model, name)), name
