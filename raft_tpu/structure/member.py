@@ -19,6 +19,23 @@ Reference behavior parity targets: Member.__init__ station/strip setup
 (raft_member.py:67-220), setPosition (:245-304), getInertia (:307-707),
 getHydrostatics (:712-874), calcHydroConstants/calcImat/getCmSides
 (:877-1088).
+
+Reference-method -> function mapping (the class methods become pure
+functions over the compiled (topology, geometry, pose) triple):
+
+=======================  =====================================
+reference Member method  this module
+=======================  =====================================
+__init__                 compile_member
+setPosition              member_pose
+getInertia               member_inertia
+getHydrostatics          member_hydrostatics
+calcHydroConstants       member_hydro_constants
+calcImat                 member_hydro_constants (Imat output)
+getCmSides (MacCamy-F.)  _imat_mcf
+correction_KAY           hydro.second_order._kim_and_yue
+plot                     Model.plot / FOWT.plot draw the poses
+=======================  =====================================
 """
 
 from __future__ import annotations
