@@ -1,0 +1,156 @@
+"""Coverage for the remaining io_utils surface and secondary model paths:
+IEA-ontology turbine conversion, WAMIT .p2 reading, tower-base stress
+PSD, mooring write-back, the 'spectrum' second-order force mode, and
+preprocess_HAMS."""
+
+import numpy as np
+import pytest
+import yaml
+
+from raft_tpu import io_utils
+
+
+def _minimal_windio(tmp_path):
+    grid = [0.0, 0.5, 1.0]
+    wt = {
+        "assembly": {"number_of_blades": 3, "rotor_diameter": 0.0,
+                     "hub_height": 150.0},
+        "components": {
+            "hub": {"diameter": 7.0, "cone_angle": np.deg2rad(4.0).item()},
+            "nacelle": {"drivetrain": {"uptilt": np.deg2rad(6.0).item(),
+                                       "overhang": -12.0,
+                                       "distance_tt_hub": 5.0}},
+            "blade": {"outer_shape_bem": {
+                "reference_axis": {
+                    "x": {"grid": grid, "values": [0.0, -1.0, -4.0]},
+                    "y": {"grid": grid, "values": [0.0, 0.0, 0.0]},
+                    "z": {"grid": grid, "values": [0.0, 58.0, 117.0]},
+                },
+                "chord": {"grid": grid, "values": [5.2, 4.0, 1.0]},
+                "twist": {"grid": grid,
+                          "values": [np.deg2rad(15.0).item(), np.deg2rad(5.0).item(), 0.0]},
+                "airfoil_position": {"grid": [0.0, 1.0], "labels": ["af1", "af1"]},
+            }},
+            "tower": {"outer_shape_bem": {"reference_axis": {
+                "z": {"grid": grid, "values": [0.0, 70.0, 145.0]}}}},
+        },
+        "environment": {"air_density": 1.225},
+        "airfoils": [{
+            "name": "af1", "relative_thickness": 0.21,
+            "polars": [{
+                "c_l": {"grid": [-3.14, 0.0, 3.14], "values": [0.0, 0.8, 0.0]},
+                "c_d": {"grid": [-3.14, 0.0, 3.14], "values": [0.5, 0.01, 0.5]},
+                "c_m": {"grid": [-3.14, 0.0, 3.14], "values": [0.0, -0.1, 0.0]},
+            }],
+        }],
+    }
+    path = tmp_path / "iea_turbine.yaml"
+    path.write_text(yaml.safe_dump(wt))
+    return str(path)
+
+
+def test_convert_iea_turbine_yaml(tmp_path):
+    d = io_utils.convert_iea_turbine_yaml(_minimal_windio(tmp_path), n_span=10)
+    assert d["nBlades"] == 3
+    assert d["Rhub"] == pytest.approx(3.5)
+    assert d["precone"] == pytest.approx(4.0)
+    assert d["Zhub"] == pytest.approx(150.0)
+    assert d["blade"]["Rtip"] == pytest.approx(117.0 + 3.5)
+    assert len(d["blade"]["r"]) == 8           # interior span points
+    assert len(d["airfoils"]) == 1
+    tab = np.asarray(d["airfoils"][0]["data"])
+    assert tab.shape[1] == 4                   # alpha, cl, cd, cm
+    assert tab[:, 0].min() < -170 and tab[:, 0].max() > 170  # degrees
+
+
+def test_read_wamit_p2(tmp_path):
+    """Synthetic .p2: 2 periods x 2 headings x 6 DoF, WAMIT normalization."""
+    rows = []
+    for per in (5.0, 10.0):
+        for hd in (0.0, 30.0):
+            for dof in range(1, 7):
+                re, im = dof * 0.1, -dof * 0.05
+                rows.append([per, hd, dof, 0.0, 0.0, re, im])
+    path = tmp_path / "out.p2"
+    np.savetxt(path, np.array(rows))
+    W2 = io_utils.read_wamit_p2(str(path), rho=1025.0, L=2.0, g=9.81)
+    assert list(W2["period"]) == [5.0, 10.0]
+    assert list(W2["heading"]) == [0.0, 30.0]
+    # surge scales by rho*g*L^2, roll by rho*g*L^3
+    assert W2["surge"][0, 0] == pytest.approx((0.1 - 0.05j) * 1025 * 9.81 * 4.0)
+    assert W2["roll"][0, 0] == pytest.approx((0.4 - 0.2j) * 1025 * 9.81 * 8.0)
+
+
+def test_tower_base_stress_psd():
+    w = np.linspace(0.1, 2.0, 40)
+    TBFA = np.exp(-(w - 0.8) ** 2) * 1e8      # fore-aft moment amplitudes
+    TBSS = 0.5 * np.exp(-(w - 0.8) ** 2) * 1e8
+    psd, ANG, FRQ = io_utils.tower_base_stress_psd(TBFA, TBSS, w)
+    psd = np.asarray(psd)
+    assert np.all(np.isfinite(psd))
+    assert np.max(psd) > 0
+    # reference quirk: one PSD value per circumferential angle
+    assert psd.shape == (50,)
+
+
+def test_adjust_mooring_roundtrip():
+    from raft_tpu.designs import demo_spar
+    from raft_tpu.mooring import system as moorsys
+
+    design = demo_spar(nw_freqs=(0.05, 0.4))
+    ms = moorsys.compile_mooring(design["mooring"])
+    out = io_utils.adjust_mooring(ms, design)
+    assert out["mooring"]["water_depth"] == pytest.approx(float(np.asarray(ms.params.depth)))
+    assert out["mooring"]["lines"][0]["length"] == pytest.approx(
+        float(np.asarray(ms.params.L)[0]))
+
+
+def test_second_order_spectrum_mode():
+    """calcHydroForce_2ndOrd interpMode='spectrum' vs 'qtf': same mean
+    drift (both integrate the same QTF diagonal) and comparable slow-
+    drift force scale."""
+    import jax
+
+    from raft_tpu.core.fowt import FOWT
+    from raft_tpu.designs import demo_spar
+    from raft_tpu.hydro import second_order as so
+    from raft_tpu.ops import waves as waves_ops
+
+    design = demo_spar(nw_freqs=(0.05, 0.4))
+    design["platform"]["potSecOrder"] = 1
+    design["platform"]["min_freq2nd"] = 0.05
+    design["platform"]["max_freq2nd"] = 0.35
+    design["platform"]["df_freq2nd"] = 0.05
+    w = np.arange(0.05, 0.4, 0.05) * 2 * np.pi
+    fowt = FOWT(design, w, depth=320.0)
+    fowt.setPosition(np.zeros(6))
+    fowt.calcStatics()
+    fowt.calcHydroConstants()
+    case = dict(zip(design["cases"]["keys"], design["cases"]["data"][0]))
+    fowt.calcHydroExcitation(case)
+    so.calc_qtf_slender_body(fowt, 0)
+
+    S0 = np.asarray(waves_ops.jonswap(np.asarray(w), 6.0, 10.0))
+    mean_q, f_q = so.calc_hydro_force_2nd_ord(fowt, 0.0, S0, interpMode="qtf")
+    mean_s, f_s = so.calc_hydro_force_2nd_ord(fowt, 0.0, S0, interpMode="spectrum")
+    assert np.all(np.isfinite(f_q)) and np.all(np.isfinite(f_s))
+    # strongest mean-drift channel: same sign, same order in both modes
+    idof = int(np.argmax(np.abs(mean_q)))
+    assert mean_q[idof] != 0
+    assert np.sign(mean_s[idof]) == np.sign(mean_q[idof])
+    assert 0.1 < abs(mean_s[idof] / mean_q[idof]) < 10.0
+
+
+def test_preprocess_hams_exports_mesh(tmp_path):
+    import raft_tpu
+    from raft_tpu.designs import demo_spar
+
+    design = demo_spar(nw_freqs=(0.05, 0.3))
+    design["platform"]["potModMaster"] = 0
+    design["platform"]["members"][0]["potMod"] = True
+    model = raft_tpu.Model(design)
+    for fowt in model.fowtList:
+        fowt.setPosition(np.zeros(6))
+        fowt.calcStatics()
+    model.preprocess_HAMS(dz=5.0, da=5.0, meshDir=str(tmp_path))
+    assert (tmp_path / "HullMesh.pnl").exists()
