@@ -211,9 +211,126 @@ def assemble_design(inputs, discrete_inputs, modeling_opts, turbine_opts,
                 "stiffness": float(np.ravel(inputs[pre + "stiffness"])[0]),
             })
 
-    if turbine_opts:
-        design["turbine"] = turbine_opts
+    turbine = _assemble_turbine(inputs, discrete_inputs, turbine_opts)
+    if turbine:
+        design["turbine"] = turbine
     return design
+
+
+def _assemble_turbine(inputs, discrete_inputs, turbine_opts):
+    """Rebuild the turbine section from flat OM inputs when present
+    (omdao_raft.py:424-499); otherwise pass turbine_opts through
+    unchanged (headless dict-driven use)."""
+    if "turbine_mRNA" not in inputs:
+        return dict(turbine_opts) if turbine_opts else None
+
+    def scal(key, default=0.0):
+        return float(np.ravel(inputs.get(key, [default]))[0])
+
+    def arr_or_scal(key):
+        v = np.atleast_1d(np.asarray(inputs[key], dtype=float))
+        return float(v[0]) if v.size == 1 else v.tolist()
+
+    t = {}
+    for k in ("mRNA", "IxRNA", "IrRNA", "xCG_RNA", "hHub", "overhang",
+              "Fthrust", "yaw_stiffness"):
+        key = "turbine_" + k
+        if key in inputs:
+            t[k] = scal(key)
+
+    pre = "turbine_tower_"
+    if pre + "rA" in inputs:
+        rA = np.asarray(inputs[pre + "rA"], dtype=float)
+        rB = np.asarray(inputs[pre + "rB"], dtype=float)
+        if rA[2] > rB[2]:  # RAFT wants rA below rB (omdao_raft.py:428-432, MHK)
+            rA, rB = rB, rA
+        tower = {
+            "name": "tower",
+            "type": 1,
+            "rA": rA.tolist(),
+            "rB": rB.tolist(),
+            "shape": (turbine_opts or {}).get("shape", "circ"),
+            "gamma": scal(pre + "gamma"),
+            "stations": np.asarray(inputs[pre + "stations"], dtype=float).tolist(),
+            "d": arr_or_scal(pre + "d"),
+            "t": arr_or_scal(pre + "t"),
+            "Cd": arr_or_scal(pre + "Cd") if pre + "Cd" in inputs else 0.6,
+            "Ca": arr_or_scal(pre + "Ca") if pre + "Ca" in inputs else 1.0,
+            "CdEnd": arr_or_scal(pre + "CdEnd") if pre + "CdEnd" in inputs else 0.6,
+            "CaEnd": arr_or_scal(pre + "CaEnd") if pre + "CaEnd" in inputs else 1.0,
+            "rho_shell": scal(pre + "rho_shell", 7850.0),
+        }
+        t["tower"] = tower
+
+    if "nBlades" in discrete_inputs:
+        t["nBlades"] = int(discrete_inputs["nBlades"])
+    for dst, src in (("shaft_tilt", "tilt"), ("precone", "precone"),
+                     ("Zhub", "wind_reference_height"), ("Rhub", "hub_radius"),
+                     ("I_drivetrain", "rotor_inertia")):
+        if src in inputs:
+            t[dst] = scal(src)
+
+    if "blade_r" in inputs:
+        t["blade"] = {
+            "geometry": np.c_[inputs["blade_r"], inputs["blade_chord"],
+                              inputs["blade_theta"], inputs["blade_precurve"],
+                              inputs["blade_presweep"]].tolist(),
+            "Rtip": scal("blade_Rtip"),
+            "precurveTip": scal("blade_precurveTip"),
+            "presweepTip": scal("blade_presweepTip"),
+        }
+        if "airfoils_position" in inputs:
+            af_names = (turbine_opts or {}).get("af_used_names", [])
+            positions = [float(ap) for ap in np.ravel(inputs["airfoils_position"])]
+            if len(af_names) != len(positions):
+                raise KeyError(
+                    "turbine_options['af_used_names'] must list one airfoil name "
+                    f"per airfoils_position entry ({len(positions)} needed, "
+                    f"{len(af_names)} given)")
+            t["blade"]["airfoils"] = list(zip(positions, af_names))
+
+    if "airfoils_aoa" in inputs:
+        aoa_deg = np.degrees(np.asarray(inputs["airfoils_aoa"], dtype=float))
+        cl = np.asarray(inputs["airfoils_cl"], dtype=float)
+        cd = np.asarray(inputs["airfoils_cd"], dtype=float)
+        cm = np.asarray(inputs["airfoils_cm"], dtype=float)
+        names = discrete_inputs.get("airfoils_name", [])
+        r_thick = np.ravel(np.asarray(inputs.get("airfoils_r_thick", []), dtype=float))
+        afs = []
+        for i in range(cl.shape[0]):
+            # reference indexes [i, :, 0, 0] (first Re/tab slice)
+            cli = cl[i].reshape(len(aoa_deg), -1)[:, 0]
+            cdi = cd[i].reshape(len(aoa_deg), -1)[:, 0]
+            cmi = cm[i].reshape(len(aoa_deg), -1)[:, 0]
+            afs.append({
+                "name": names[i] if i < len(names) else f"af{i}",
+                "relative_thickness": float(r_thick[i]) if i < len(r_thick) else 0.2,
+                "data": np.c_[aoa_deg, cli, cdi, cmi].tolist(),
+            })
+        t["airfoils"] = afs
+
+    if "rotor_PC_GS_angles" in inputs:
+        t["gear_ratio"] = scal("gear_ratio", 1.0)  # omdao_raft.py:419
+        t["pitch_control"] = {
+            "GS_Angles": np.asarray(inputs["rotor_PC_GS_angles"], dtype=float).tolist(),
+            "GS_Kp": np.asarray(inputs["rotor_PC_GS_Kp"], dtype=float).tolist(),
+            "GS_Ki": np.asarray(inputs["rotor_PC_GS_Ki"], dtype=float).tolist(),
+            "Fl_Kp": scal("Fl_Kp"),
+        }
+        t["torque_control"] = {"VS_KP": scal("rotor_TC_VS_Kp"),
+                               "VS_KI": scal("rotor_TC_VS_Ki")}
+
+    if "rotor_powercurve_v" in inputs:
+        t["wt_ops"] = {
+            "v": np.asarray(inputs["rotor_powercurve_v"], dtype=float).tolist(),
+            "omega_op": np.asarray(inputs["rotor_powercurve_omega_rpm"], dtype=float).tolist(),
+            "pitch_op": np.asarray(inputs["rotor_powercurve_pitch"], dtype=float).tolist(),
+        }
+
+    # non-flat extras (polar tables etc.) supplied via options pass through
+    for k, v in (turbine_opts or {}).items():
+        t.setdefault(k, v)
+    return t
 
 
 STATS_NAMES = ("surge", "sway", "heave", "roll", "pitch", "yaw",

@@ -367,3 +367,85 @@ def test_reference_api_surface(tmp_path):
     for name in ("powerThrustCurve", "florisCoupling",
                  "florisFindEquilibrium", "florisCalcAEP"):
         assert callable(getattr(model, name)), name
+
+
+def test_omdao_turbine_assembly():
+    """Flat OM turbine inputs rebuild a working turbine dict
+    (omdao_raft.py:424-499): IEA15MW flattened -> assembled -> Rotor
+    runs calcAero with results matching the dict-driven rotor."""
+    import yaml
+
+    from raft_tpu.omdao import assemble_design
+    from raft_tpu.rotor.rotor import Rotor
+
+    with open("/root/reference/tests/test_data/IEA15MW.yaml") as f:
+        ref = yaml.load(f, Loader=yaml.FullLoader)
+    t = ref["turbine"]
+    geom = np.asarray(t["blade"]["geometry"], dtype=float)
+    afs = t["airfoils"]
+    # common AoA grid like WEIS provides (per-airfoil polars differ in length)
+    aoa_deg = np.linspace(-180.0, 180.0, 100)
+    aoa = np.radians(aoa_deg)
+
+    def resample(a, col):
+        tab = np.asarray(a["data"], dtype=float)
+        return np.interp(aoa_deg, tab[:, 0], tab[:, col])
+    inputs = {
+        "mooring_water_depth": [200.0],
+        "turbine_mRNA": [t["mRNA"]], "turbine_IxRNA": [t["IxRNA"]],
+        "turbine_IrRNA": [t["IrRNA"]], "turbine_xCG_RNA": [t["xCG_RNA"]],
+        "turbine_hHub": [t["hHub"]], "turbine_overhang": [t["overhang"]],
+        "turbine_tower_rA": t["tower"]["rA"], "turbine_tower_rB": t["tower"]["rB"],
+        "turbine_tower_gamma": [0.0],
+        "turbine_tower_stations": t["tower"]["stations"],
+        "turbine_tower_d": t["tower"]["d"], "turbine_tower_t": t["tower"]["t"],
+        "turbine_tower_Cd": t["tower"]["Cd"], "turbine_tower_Ca": t["tower"]["Ca"],
+        "turbine_tower_CdEnd": t["tower"]["CdEnd"],
+        "turbine_tower_CaEnd": t["tower"]["CaEnd"],
+        "turbine_tower_rho_shell": [t["tower"]["rho_shell"]],
+        "tilt": [t["shaft_tilt"]], "precone": [t["precone"]],
+        "wind_reference_height": [t["Zhub"]], "hub_radius": [t["Rhub"]],
+        "rotor_inertia": [t.get("I_drivetrain", 0.0)],
+        "blade_r": geom[:, 0], "blade_chord": geom[:, 1],
+        "blade_theta": geom[:, 2], "blade_precurve": geom[:, 3],
+        "blade_presweep": geom[:, 4],
+        "blade_Rtip": [t["blade"]["Rtip"]],
+        "blade_precurveTip": [t["blade"].get("precurveTip", 0.0)],
+        "blade_presweepTip": [t["blade"].get("presweepTip", 0.0)],
+        "airfoils_position": [p for p, _ in t["blade"]["airfoils"]],
+        "airfoils_aoa": aoa,
+        "airfoils_cl": np.stack([resample(a, 1) for a in afs])[:, :, None, None],
+        "airfoils_cd": np.stack([resample(a, 2) for a in afs])[:, :, None, None],
+        "airfoils_cm": np.stack([resample(a, 3) for a in afs])[:, :, None, None],
+        "airfoils_r_thick": [a["relative_thickness"] for a in afs],
+        "rotor_powercurve_v": t["wt_ops"]["v"],
+        "rotor_powercurve_omega_rpm": t["wt_ops"]["omega_op"],
+        "rotor_powercurve_pitch": t["wt_ops"]["pitch_op"],
+    }
+    dins = {"nBlades": t["nBlades"],
+            "airfoils_name": [a["name"] for a in afs]}
+    design = assemble_design(
+        inputs, dins, modeling_opts={"potModMaster": 1},
+        turbine_opts={"af_used_names": [n for _, n in t["blade"]["airfoils"]],
+                      "shape": "circ"},
+        mooring_opts={}, member_opts={"nmembers": 0}, analysis_opts={})
+    ta = design["turbine"]
+    assert ta["nBlades"] == t["nBlades"]
+    np.testing.assert_allclose(np.asarray(ta["blade"]["geometry"]), geom)
+
+    # the assembled turbine drives the BEM rotor like the dict-driven one
+    w = np.arange(0.05, 0.4, 0.05) * 2 * np.pi
+    for tt in (ta,):
+        tt["nrotors"] = 1
+        if isinstance(tt.get("tower"), dict):
+            tt["tower"] = [tt["tower"]]
+        for k, d in [("rho_air", 1.225), ("mu_air", 1.81e-05), ("shearExp_air", 0.12),
+                     ("rho_water", 1025.0), ("mu_water", 1.0e-03), ("shearExp_water", 0.12)]:
+            tt[k] = d
+    rotor = Rotor(ta, w, 0)
+    rotor.setPosition()
+    case = {"wind_speed": 10.0, "wind_heading": 0, "turbulence": 0,
+            "turbine_status": "operating", "yaw_misalign": 0}
+    f0, f, a, b = rotor.calcAero(case)
+    assert np.isfinite(np.asarray(f0)).all()
+    assert abs(np.asarray(f0)[0]) > 1e5  # thrust-scale force present
