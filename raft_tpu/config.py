@@ -25,3 +25,33 @@ def force_cpu() -> None:
     choice at interpreter start (see tests/conftest.py for why env vars
     are not enough in this environment)."""
     jax.config.update("jax_platforms", "cpu")
+
+
+def force_host_mesh(n_devices: int) -> None:
+    """Virtualize an ``n_devices``-wide CPU device mesh in this process.
+
+    Sets/overwrites ``--xla_force_host_platform_device_count`` and forces
+    the cpu platform, then verifies the topology actually took effect.
+    Both knobs are only honored before the JAX backend initializes, and a
+    platform switch after initialization is a *silent* no-op — so this
+    raises instead of letting callers proceed on the wrong mesh.
+    """
+    import os
+    import re
+
+    flags = os.environ.get("XLA_FLAGS", "")
+    pat = re.compile(r"--xla_force_host_platform_device_count=(\d+)")
+    m = pat.search(flags)
+    if m is None or int(m.group(1)) != n_devices:
+        flags = pat.sub("", flags).strip()
+        os.environ["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n_devices}"
+        ).strip()
+    force_cpu()
+    devices = jax.devices()
+    if devices[0].platform != "cpu" or len(devices) < n_devices:
+        raise RuntimeError(
+            f"force_host_mesh({n_devices}) ineffective: backend already "
+            f"initialized with {len(devices)} {devices[0].platform} device(s). "
+            "Call it before any jax.devices()/jit use in this process."
+        )

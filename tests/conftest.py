@@ -13,13 +13,9 @@ import, so the platform must be forced back to ``cpu`` through
 
 import os
 
-flags = os.environ.get("XLA_FLAGS", "")
-if "xla_force_host_platform_device_count" not in flags:
-    os.environ["XLA_FLAGS"] = (flags + " --xla_force_host_platform_device_count=8").strip()
+from raft_tpu import config as _config
 
-from raft_tpu import config as _config  # noqa: E402
-
-_config.force_cpu()
+_config.force_host_mesh(8)
 _config.enable_x64()
 
 import pytest  # noqa: E402
