@@ -19,19 +19,20 @@ def apply_rotor_aero(fowt, rot, ir, case, current, speed):
     ``speed`` is the already-validated hub inflow speed resolved by
     calcTurbineConstants (wind or current depending on submergence).
     """
+    import jax.numpy as jnp
+
     f_aero0, f_aero, a_aero, b_aero = rot.calcAero(case, current=current)
 
     r_hub = np.asarray(rot.r_hub_rel)
-    for iw in range(fowt.nw):
-        fowt.A_aero[:, :, iw, ir] = np.asarray(
-            transforms.translate_matrix_6to6(a_aero[:, :, iw], r_hub)
-        )
-        fowt.B_aero[:, :, iw, ir] = np.asarray(
-            transforms.translate_matrix_6to6(b_aero[:, :, iw], r_hub)
-        )
+    # hub->platform translation batched over the whole frequency axis
+    # (the reference loops per-ω; raft_fowt.py:816-823)
+    A6 = transforms.translate_matrix_6to6(jnp.moveaxis(jnp.asarray(a_aero), 2, 0), jnp.asarray(r_hub))
+    B6 = transforms.translate_matrix_6to6(jnp.moveaxis(jnp.asarray(b_aero), 2, 0), jnp.asarray(r_hub))
+    fowt.A_aero[:, :, :, ir] = np.moveaxis(np.asarray(A6), 0, 2)
+    fowt.B_aero[:, :, :, ir] = np.moveaxis(np.asarray(B6), 0, 2)
     fowt.f_aero0[:, ir] = np.asarray(transforms.transform_force(f_aero0, offset=r_hub))
-    for iw in range(fowt.nw):
-        fowt.f_aero[:, iw, ir] = np.asarray(transforms.transform_force(f_aero[:, iw], offset=r_hub))
+    fowt.f_aero[:, :, ir] = np.asarray(
+        transforms.transform_force(jnp.asarray(f_aero).T, offset=r_hub)).T
 
     # gyroscopic damping (raft_fowt.py:829-840)
     if rot.Uhub.size:

@@ -29,6 +29,61 @@ from ..mooring import system as moorsys
 from ..rotor import Rotor
 
 
+def compile_member_list(design, heading_adjust=0.0, dls_max_default=None):
+    """Compile the full member list for one FOWT: platform members with
+    heading repeats, then towers, then nacelles (raft_fowt.py:61-103).
+
+    Shared by ``FOWT.__init__`` and the batched design compiler
+    (:mod:`raft_tpu.parallel.design_batch`) so sweep variants parse
+    through exactly the same semantics as the model itself.  Returns
+    (memberList, nplatmems, ntowers).  ``turbine`` sub-dicts are
+    normalized in place the same way FOWT does.
+    """
+    platform = design["platform"]
+    potModMaster = int(get_from_dict(platform, "potModMaster", dtype=int, default=0))
+    if dls_max_default is None:
+        dls_max_default = float(get_from_dict(platform, "dlsMax", default=5.0))
+
+    nplatmems = 0
+    for mi in platform["members"]:
+        nplatmems += len(mi["heading"]) if "heading" in mi and not np.isscalar(mi["heading"]) else 1
+
+    memberList: list[mstruct.CompiledMember] = []
+    for mi in platform["members"]:
+        mi = dict(mi)
+        if potModMaster == 1:
+            mi["potMod"] = False
+        elif potModMaster in (2, 3):
+            mi["potMod"] = True
+        if "dlsMax" not in mi:
+            mi["dlsMax"] = dls_max_default
+        headings = get_from_dict(mi, "heading", shape=-1, default=0.0)
+        if np.isscalar(headings):
+            memberList.append(mstruct.compile_member(mi, heading=float(headings) + heading_adjust))
+        else:
+            for h in headings:
+                memberList.append(mstruct.compile_member(mi, heading=float(h) + heading_adjust))
+
+    ntowers = 0
+    turbine = design.get("turbine", None)
+    if turbine is not None:
+        nrotors = int(get_from_dict(turbine, "nrotors", dtype=int, shape=0, default=1))
+        if "tower" in turbine:
+            if isinstance(turbine["tower"], dict):
+                turbine["tower"] = [turbine["tower"]] * nrotors
+            ntowers = len(turbine["tower"])
+            for mem in turbine["tower"]:
+                memberList.append(mstruct.compile_member(mem))
+        if "nacelle" in turbine:
+            if isinstance(turbine["nacelle"], dict):
+                turbine["nacelle"] = [turbine["nacelle"]] * nrotors
+            for mem in turbine["nacelle"]:
+                mem = dict(mem)
+                mem["name"] = "nacelle"
+                memberList.append(mstruct.compile_member(mem))
+    return memberList, nplatmems, ntowers
+
+
 # ---------------------------------------------------------------------------
 # traced member-level kernels (pure functions of compiled member + pose)
 # ---------------------------------------------------------------------------
@@ -173,6 +228,17 @@ def _member_current_drag(topo, geom, pose, speed, heading_deg, depth, z_ref, she
     return jnp.sum(F6, axis=0)
 
 
+# jit caching: these run per member per drag-linearization iteration in
+# analyzeCases; the topology is static/hashable, so jit caches one fused
+# trace per (topology, shapes) — see the matching note in
+# structure/member.py.
+_member_wave_kinematics = jax.jit(_member_wave_kinematics)
+_member_inertial_excitation = jax.jit(_member_inertial_excitation, static_argnums=0)
+_member_drag_linearization = jax.jit(_member_drag_linearization, static_argnums=0)
+_member_drag_excitation = jax.jit(_member_drag_excitation)
+_member_current_drag = jax.jit(_member_current_drag, static_argnums=0)
+
+
 # ---------------------------------------------------------------------------
 # FOWT
 # ---------------------------------------------------------------------------
@@ -211,40 +277,16 @@ class FOWT:
         dlsMax = float(get_from_dict(platform, "dlsMax", default=5.0))
         self.yawstiff = float(platform.get("yaw_stiffness", 0.0))
 
-        # count platform members incl. heading repeats (raft_fowt.py:61-67)
-        self.nplatmems = 0
-        for mi in platform["members"]:
-            self.nplatmems += len(mi["heading"]) if "heading" in mi and not np.isscalar(mi["heading"]) else 1
-
         # ----- compile members (platform + towers + nacelles) -----
-        self.memberList: list[mstruct.CompiledMember] = []
-        for mi in platform["members"]:
-            mi = dict(mi)
-            if self.potModMaster == 1:
-                mi["potMod"] = False
-            elif self.potModMaster in (2, 3):
-                mi["potMod"] = True
-            if "dlsMax" not in mi:
-                mi["dlsMax"] = dlsMax
-            headings = get_from_dict(mi, "heading", shape=-1, default=0.0)
-            if np.isscalar(headings):
-                self.memberList.append(mstruct.compile_member(mi, heading=float(headings) + heading_adjust))
-            else:
-                for h in headings:
-                    self.memberList.append(mstruct.compile_member(mi, heading=float(h) + heading_adjust))
+        self.memberList, self.nplatmems, self.ntowers = compile_member_list(
+            design, heading_adjust=heading_adjust, dls_max_default=dlsMax
+        )
 
         self.nrotors = 0
-        self.ntowers = 0
         turbine = design.get("turbine", None)
         if turbine is not None:
             self.nrotors = int(get_from_dict(turbine, "nrotors", dtype=int, shape=0, default=1))
             turbine["nrotors"] = self.nrotors
-            if "tower" in turbine:
-                if isinstance(turbine["tower"], dict):
-                    turbine["tower"] = [turbine["tower"]] * self.nrotors
-                self.ntowers = len(turbine["tower"])
-                for mem in turbine["tower"]:
-                    self.memberList.append(mstruct.compile_member(mem))
             # copy site fluid properties into the turbine dict (raft_fowt.py:85-90)
             turbine["rho_air"] = float(get_from_dict(site, "rho_air", shape=0, default=1.225))
             turbine["mu_air"] = float(get_from_dict(site, "mu_air", shape=0, default=1.81e-05))
@@ -252,13 +294,6 @@ class FOWT:
             turbine["rho_water"] = float(get_from_dict(site, "rho_water", shape=0, default=1025.0))
             turbine["mu_water"] = float(get_from_dict(site, "mu_water", shape=0, default=1.0e-03))
             turbine["shearExp_water"] = float(get_from_dict(site, "shearExp_water", shape=0, default=0.12))
-            if "nacelle" in turbine:
-                if isinstance(turbine["nacelle"], dict):
-                    turbine["nacelle"] = [turbine["nacelle"]] * self.nrotors
-                for mem in turbine["nacelle"]:
-                    mem = dict(mem)
-                    mem["name"] = "nacelle"
-                    self.memberList.append(mstruct.compile_member(mem))
 
         # ----- rotors -----
         self.rotorList: list[Rotor] = []
@@ -275,6 +310,9 @@ class FOWT:
             self.ms = None
         self.F_moor0 = np.zeros(6)
         self.C_moor = np.zeros([6, 6])
+        # uniform current applied to mooring lines for the active case
+        # (set by Model.solveStatics when mooring currentMod > 0)
+        self.ms_current = np.zeros(3)
 
         # ballast accounting groups for m_ballast parity (raft_fowt.py:505-516):
         # densities of substructure segments in member order, zero-length
@@ -374,8 +412,9 @@ class FOWT:
             self._poses[i] = mstruct.member_pose(cm.topo, cm.geom, r6j)
 
         if self.ms is not None:
-            self.C_moor = np.asarray(moorsys.coupled_stiffness(self.ms, self.ms.params, r6j))
-            self.F_moor0 = np.asarray(moorsys.body_forces(self.ms, self.ms.params, r6j))
+            mpar = moorsys.params_with_current(self.ms, self.ms_current)
+            self.C_moor = np.asarray(moorsys.coupled_stiffness(self.ms, mpar, r6j))
+            self.F_moor0 = np.asarray(moorsys.body_forces(self.ms, mpar, r6j))
 
     # ------------------------------------------------------------------
     # statics
@@ -847,8 +886,9 @@ class FOWT:
         if self.ms is not None:
             nLines = self.ms.n_lines
             r6j = jnp.asarray(self.r6)
-            J_moor = np.asarray(moorsys.tension_jacobian(self.ms, self.ms.params, r6j))
-            T_moor = np.asarray(moorsys.tensions(self.ms, self.ms.params, r6j))
+            mpar = moorsys.params_with_current(self.ms, self.ms_current)
+            J_moor = np.asarray(moorsys.tension_jacobian(self.ms, mpar, r6j))
+            T_moor = np.asarray(moorsys.tensions(self.ms, mpar, r6j))
             T_amps = np.einsum("td,hdw->htw", J_moor, Xi)
             results["Tmoor_avg"] = T_moor
             results["Tmoor_std"] = np.zeros(2 * nLines)

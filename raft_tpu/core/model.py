@@ -88,10 +88,17 @@ class Model:
                     body_coords = [
                         [fi["x_location"], fi["y_location"]] for fi in fowtInfo
                     ]
+                    # array-level bathymetry (raft_model.py:85-89): local
+                    # depths drive each line's seabed-contact state
+                    bathymetry = None
+                    if design["array_mooring"].get("bathymetry"):
+                        bathymetry = moorsys.read_bathymetry_file(
+                            resolve_path(design, design["array_mooring"]["bathymetry"]))
                     moor_file = resolve_path(design, design["array_mooring"]["file"])
                     self.ms = moorsys.compile_moordyn_file(
                         moor_file, depth=self.depth,
                         body_coords=body_coords,
+                        bathymetry=bathymetry,
                     )
                 else:
                     raise Exception(
@@ -134,6 +141,8 @@ class Model:
         self.mooring_currentMod = get_from_dict(
             design.get("mooring", {}) or {}, "currentMod", default=0, dtype=int
         )
+        # uniform current applied to mooring lines for the active case
+        self.ms_current = np.zeros(3)
         self.results = {}
 
     # ------------------------------------------------------------------
@@ -222,8 +231,10 @@ class Model:
                 self.results["case_metrics"][iCase]["array_mooring"] = am
                 r6s = self._fowt_positions()
                 nLines = self.ms.n_lines
-                J_moor = np.asarray(moorsys.array_tension_jacobian(self.ms, r6s))
-                T_moor = np.asarray(moorsys.array_tensions(self.ms, r6s))
+                J_moor = np.asarray(moorsys.array_tension_jacobian(
+                    self.ms, r6s, current=self.ms_current))
+                T_moor = np.asarray(moorsys.array_tensions(self.ms, r6s,
+                                                           current=self.ms_current))
                 T_amps = np.einsum("td,hdw->htw", J_moor, self.Xi)
                 am["Tmoor_avg"] = T_moor
                 am["Tmoor_std"] = np.zeros(2 * nLines)
@@ -252,7 +263,8 @@ class Model:
             C_tot[i1:i2, i1:i2] += fowt.C_struc + fowt.C_hydro + fowt.C_moor
             C_tot[i1 + 5, i1 + 5] += fowt.yawstiff
         if self.ms is not None:
-            C_tot += np.asarray(moorsys.array_coupled_stiffness(self.ms, self._fowt_positions()))
+            C_tot += np.asarray(moorsys.array_coupled_stiffness(
+                self.ms, self._fowt_positions(), current=self.ms_current))
 
         fns, modes = _sorted_eigen(M_tot, C_tot)
 
@@ -290,6 +302,30 @@ class Model:
 
         caseorig = copy.deepcopy(case) if case else None
 
+        # mooring-line current loads (reference: raft_model.py:560-578 sets
+        # currentMod/current on every MoorPy system before the solve; zero
+        # current when unloaded or currentMod == 0)
+        cur = np.zeros(3)
+        if case and self.mooring_currentMod > 0:
+            cs = float(get_from_dict(case, "current_speed", shape=0, default=0.0))
+            ch = float(get_from_dict(case, "current_heading", shape=0, default=0))
+            if cs > 0:
+                cur = np.array([cs * np.cos(np.radians(ch)), cs * np.sin(np.radians(ch)), 0.0])
+                systems = [f.ms for f in self.fowtList if f.ms is not None]
+                if self.ms is not None:
+                    systems.append(self.ms)
+                if systems and all(
+                    float(np.max(np.abs(np.asarray(m.params.Cd_n)))) == 0.0 for m in systems
+                ):
+                    import warnings
+
+                    warnings.warn(
+                        "mooring currentMod > 0 but every line's transverse_drag "
+                        "is zero - line current loads will have no effect")
+        self.ms_current = cur
+        for fowt in self.fowtList:
+            fowt.ms_current = cur
+
         for i, fowt in enumerate(self.fowtList):
             X_initial[6 * i : 6 * i + 6] = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0])
             fowt.setPosition(X_initial[6 * i : 6 * i + 6])
@@ -326,14 +362,16 @@ class Model:
                 Fnet[6 * i : 6 * i + 6] += fowt.F_moor0
             if self.ms is not None:
                 Fnet += np.asarray(
-                    moorsys.array_body_forces(self.ms, self._fowt_positions())
+                    moorsys.array_body_forces(self.ms, self._fowt_positions(),
+                                              current=self.ms_current)
                 ).reshape(-1)
             return Fnet
 
         def step_func(X, Y):
             K = np.zeros([nDOF, nDOF])
             if self.ms is not None:
-                K += np.asarray(moorsys.array_coupled_stiffness(self.ms, self._fowt_positions()))
+                K += np.asarray(moorsys.array_coupled_stiffness(
+                    self.ms, self._fowt_positions(), current=self.ms_current))
             for i, fowt in enumerate(self.fowtList):
                 K6 = K_hydrostatic[i].copy()
                 if fowt.ms is not None:
@@ -475,7 +513,8 @@ class Model:
             Z_sys[i1:i2, i1:i2] += fowt.Z
         if self.ms is not None:
             Z_sys += np.asarray(
-                moorsys.array_coupled_stiffness(self.ms, self._fowt_positions())
+                moorsys.array_coupled_stiffness(self.ms, self._fowt_positions(),
+                                                current=self.ms_current)
             )[:, :, None]
 
         # batched inverse over ω (fused batch-last Gauss-Jordan; unrolled

@@ -90,3 +90,30 @@ def demo_spar(depth=320.0, nw_freqs=(0.005, 1.0)) -> dict:
                             "mass_density": 77.7, "stiffness": 3.84e8}],
         },
     }
+
+
+def production_design(min_freq=0.005, max_freq=1.0):
+    """The BASELINE production configuration: the reference VolturnUS-S
+    design (aero-servo control on) with the 200-bin frequency grid, or
+    the built-in demo spar when the reference data is absent.
+
+    Returns (design_dict, has_turbine, display_name).  Shared by
+    ``bench.py`` and the driver's multi-chip dry run so both always
+    exercise the same configuration.
+    """
+    import os
+
+    for path, name in (
+        ("/root/reference/designs/VolturnUS-S.yaml", "VolturnUS-S (aeroServoMod 2)"),
+        ("/root/reference/tests/test_data/VolturnUS-S.yaml", "VolturnUS-S"),
+    ):
+        if os.path.exists(path):
+            import yaml
+
+            with open(path) as f:
+                design = yaml.load(f, Loader=yaml.FullLoader)
+            design.setdefault("settings", {})
+            design["settings"]["min_freq"] = min_freq
+            design["settings"]["max_freq"] = max_freq
+            return design, True, name
+    return demo_spar(nw_freqs=(min_freq, max_freq)), False, "demo-spar"

@@ -23,8 +23,10 @@ implicit differentiation comes for free because each catenary call
 already carries implicit-function JVPs, and the equilibrium itself is
 re-linearized through a custom JVP on the solve.
 
-Not yet modeled (reference parity TODOs): current drag on mooring lines
-(``ms.currentMod``, raft_model.py:572-578) and bathymetry files.
+Line current drag (``ms.currentMod``, raft_model.py:572-578) is modeled
+through ``MooringParams.current`` (see ``_line_forces_at_points``), and
+array-level bathymetry files (raft_model.py:85-89) through
+``read_bathymetry_file`` + per-line local contact depths.
 """
 
 from __future__ import annotations
@@ -68,6 +70,11 @@ class MooringParams:
     w: jnp.ndarray  # [n_lines] submerged weight per length
     cb: jnp.ndarray  # [n_lines] seabed friction (<0 = no seabed contact)
     depth: jnp.ndarray  # [] water depth
+    d_vol: jnp.ndarray  # [n_lines] volume-equivalent diameter (current drag)
+    Cd_n: jnp.ndarray  # [n_lines] transverse (normal) drag coefficient
+    Cd_ax: jnp.ndarray  # [n_lines] tangential drag coefficient
+    current: jnp.ndarray  # [3] uniform current velocity (zeros = off)
+    rho: jnp.ndarray  # [] water density (line drag)
 
 
 @dataclasses.dataclass(frozen=True)
@@ -85,7 +92,10 @@ class CompiledMooring:
     line_iA: Tuple[int, ...]
     line_iB: Tuple[int, ...]
     free_idx: Tuple[int, ...]  # indices of free points
-    params: MooringParams
+    # excluded from eq/hash so the compiled object is a valid static jit
+    # argument: systems sharing a topology share a trace, and the traced
+    # functions take the (varying) params explicitly
+    params: MooringParams = dataclasses.field(compare=False)
     p_body: Tuple[int, ...] = ()
     n_bodies: int = 1
 
@@ -136,7 +146,7 @@ def compile_mooring(mooring: dict, x_ref: float = 0.0, y_ref: float = 0.0,
         vols.append(float(pt.get("volume", 0.0)))
     idx = {n: i for i, n in enumerate(names)}
 
-    iA, iB, Ls, EAs, ws, cbs = [], [], [], [], [], []
+    iA, iB, Ls, EAs, ws, cbs, ds, cdns, cdaxs = [], [], [], [], [], [], [], [], []
     for ln in mooring["lines"]:
         a, b = idx[ln["endA"]], idx[ln["endB"]]
         lt = ltypes[ln["type"]]
@@ -147,6 +157,11 @@ def compile_mooring(mooring: dict, x_ref: float = 0.0, y_ref: float = 0.0,
         ws.append(_submerged_weight(float(lt["diameter"]), float(lt["mass_density"]), rho, g))
         # seabed contact only when the line's lower end sits on the seabed
         cbs.append(_seabed_cb(min(locs[a][2], locs[b][2]), depth))
+        ds.append(float(lt["diameter"]))
+        # schema keys per docs/usage.rst:416-427; used only when a case
+        # switches line current drag on (mooring currentMod > 0)
+        cdns.append(float(lt.get("transverse_drag", 0.0)))
+        cdaxs.append(float(lt.get("tangential_drag", 0.0)))
 
     # reference-position transform (raft_fowt.py:185): rotate about z then shift
     th = np.deg2rad(heading_adjust)
@@ -169,6 +184,11 @@ def compile_mooring(mooring: dict, x_ref: float = 0.0, y_ref: float = 0.0,
         w=jnp.asarray(np.array(ws)),
         cb=jnp.asarray(np.array(cbs)),
         depth=jnp.asarray(depth),
+        d_vol=jnp.asarray(np.array(ds)),
+        Cd_n=jnp.asarray(np.array(cdns)),
+        Cd_ax=jnp.asarray(np.array(cdaxs)),
+        current=jnp.zeros(3),
+        rho=jnp.asarray(float(rho)),
     )
     return CompiledMooring(
         n_points=len(names),
@@ -213,31 +233,72 @@ def point_positions(ms: CompiledMooring, params: MooringParams, r6, free_xyz=Non
 
 def _line_forces_at_points(ms: CompiledMooring, params: MooringParams, pos):
     """Per-line end forces in 3-D. Returns (F_endA, F_endB) arrays [n_lines,3]
-    and end tensions (TA, TB) [n_lines]."""
+    and end tensions (TA, TB) [n_lines].
+
+    Current drag (``params.current`` nonzero — the MoorPy ``currentMod=1``
+    capability, raft_model.py:572-578): a uniform distributed load per
+    unit length from the chord-frame normal/tangential decomposition,
+
+        q = ½ρ·d·Cd_n·|U_n|·U_n + ½ρ·d·π·Cd_ax·|U_t|·U_t ,
+
+    handled two ways.  Free-hanging lines solve the catenary exactly in
+    the plane of the effective distributed load (weight + drag), which
+    reduces to the vertical frame when the current is zero.  Seabed-
+    contact lines keep the vertical-frame contact catenary (the grounded
+    formulation assumes gravity-normal seabed) with the vertical drag
+    component folded into the weight and the horizontal component lumped
+    half to each end — an approximation consistent with MoorPy's own
+    quasi-static treatment of line current loads.
+    """
     iA = jnp.array(ms.line_iA)
     iB = jnp.array(ms.line_iB)
     rA = pos[iA]
     rB = pos[iB]
 
-    d = rB - rA
-    # work in the lo->hi frame expected by the catenary solver
-    swap = d[:, 2] < 0.0
+    d3 = rB - rA
+    chord = jnp.sqrt(jnp.sum(d3**2, axis=1) + 1e-16)
+    e = d3 / chord[:, None]
+
+    # distributed current drag per unit length on the chord frame
+    U = params.current
+    Ut_mag = e @ U
+    Ut = Ut_mag[:, None] * e
+    Un = U[None, :] - Ut
+    Un_mag = jnp.sqrt(jnp.sum(Un**2, axis=1) + 1e-16)
+    coef = 0.5 * params.rho * params.d_vol
+    q = (coef * params.Cd_n * Un_mag)[:, None] * Un \
+        + (coef * jnp.pi * params.Cd_ax * jnp.abs(Ut_mag))[:, None] * Ut
+
+    contact = params.cb >= 0.0
+    f_d = q.at[:, 2].add(-params.w)  # effective distributed load vector
+    w_eff = jnp.sqrt(jnp.sum(f_d**2, axis=1) + 1e-16)
+    zhat_t = -f_d / w_eff[:, None]
+    up = jnp.zeros_like(zhat_t).at[:, 2].set(1.0)
+    zhat = jnp.where(contact[:, None], up, zhat_t)
+    w_line = jnp.where(contact, params.w - q[:, 2], w_eff)
+
+    # lo->hi frame (by effective-vertical separation) for the 2-D solver
+    swap = jnp.sum(d3 * zhat, axis=1) < 0.0
     lo = jnp.where(swap[:, None], rB, rA)
     hi = jnp.where(swap[:, None], rA, rB)
-    dh = hi[:, :2] - lo[:, :2]
-    xf = jnp.sqrt(jnp.sum(dh**2, axis=1) + 1e-16)
-    zf = hi[:, 2] - lo[:, 2]
-    u = dh / xf[:, None]  # horizontal unit vector lo -> hi
+    D = hi - lo
+    zf = jnp.sum(D * zhat, axis=1)
+    xvec = D - zf[:, None] * zhat
+    xf = jnp.sqrt(jnp.sum(xvec**2, axis=1) + 1e-16)
+    xhat = xvec / xf[:, None]
 
-    HA, VA, HF, VF = jax.vmap(line_end_forces)(xf, zf, params.L, params.EA, params.w, params.cb)
+    HA, VA, HF, VF = jax.vmap(line_end_forces)(xf, zf, params.L, params.EA, w_line, params.cb)
 
-    F_lo = jnp.stack([HA * u[:, 0], HA * u[:, 1], VA], axis=1)
-    F_hi = jnp.stack([-HF * u[:, 0], -HF * u[:, 1], -VF], axis=1)
+    # lumped horizontal drag on contact lines: global equilibrium gives
+    # F_lo + F_hi = -w·L·ẑ + q·L, so each end carries half the drag load
+    lump = (0.5 * params.L * contact)[:, None] * q.at[:, 2].set(0.0)
+    F_lo = HA[:, None] * xhat + VA[:, None] * zhat + lump
+    F_hi = -HF[:, None] * xhat - VF[:, None] * zhat + lump
 
     F_A = jnp.where(swap[:, None], F_hi, F_lo)
     F_B = jnp.where(swap[:, None], F_lo, F_hi)
-    TA_ = jnp.sqrt(HA**2 + VA**2)
-    TB_ = jnp.sqrt(HF**2 + VF**2)
+    TA_ = jnp.sqrt(jnp.sum(F_lo**2, axis=1))
+    TB_ = jnp.sqrt(jnp.sum(F_hi**2, axis=1))
     TA = jnp.where(swap, TB_, TA_)
     TB = jnp.where(swap, TA_, TB_)
     return F_A, F_B, TA, TB
@@ -371,44 +432,97 @@ def tension_jacobian(ms: CompiledMooring, params: MooringParams, r6):
 # ---------------------------------------------------------------------------
 
 
-def array_body_forces(ms: CompiledMooring, r6s):
+def params_with_current(ms: CompiledMooring, current) -> MooringParams:
+    """The system's params with the uniform current velocity substituted —
+    the per-case hook for line current drag (reference: Model.solveStatics
+    sets ms.currentMod/ms.current per case, raft_model.py:560-578)."""
+    return dataclasses.replace(ms.params, current=jnp.asarray(current, dtype=ms.params.p_loc.dtype))
+
+
+def read_bathymetry_file(path: str):
+    """Read a MoorPy-style bathymetry grid file; returns a bilinear
+    (x, y) -> depth callable (reference: mp.System(bathymetry=file),
+    raft_model.py:85-89).
+
+    Format: a header line, ``nGridX n`` / ``nGridY m`` lines, one row of
+    n x-coordinates, then m rows of ``y  d_1 ... d_n`` (depths positive
+    down).
+    """
+    with open(path) as f:
+        rows = [ln.split() for ln in f if ln.strip()]
+    nx = ny = None
+    data = []
+    xs = None
+    for p in rows:
+        key = p[0].lower()
+        if key == "ngridx":
+            nx = int(p[1])
+        elif key == "ngridy":
+            ny = int(p[1])
+        elif nx is not None and xs is None and len(p) == nx:
+            xs = np.array(p, dtype=float)
+        else:
+            try:
+                data.append(np.array(p, dtype=float))
+            except ValueError:
+                continue  # header/comment line
+    if xs is None or nx is None or ny is None or len(data) < ny:
+        raise ValueError(f"unrecognized bathymetry file format: {path}")
+    grid = np.stack(data[:ny])  # rows: [y, d_1..d_nx]
+    ys = grid[:, 0]
+    depths = grid[:, 1:]
+
+    def depth_at(x, y):
+        ix = np.clip(np.searchsorted(xs, x) - 1, 0, nx - 2)
+        iy = np.clip(np.searchsorted(ys, y) - 1, 0, ny - 2)
+        tx = np.clip((x - xs[ix]) / (xs[ix + 1] - xs[ix]), 0.0, 1.0)
+        ty = np.clip((y - ys[iy]) / (ys[iy + 1] - ys[iy]), 0.0, 1.0)
+        return ((1 - tx) * (1 - ty) * depths[iy, ix] + tx * (1 - ty) * depths[iy, ix + 1]
+                + (1 - tx) * ty * depths[iy + 1, ix] + tx * ty * depths[iy + 1, ix + 1])
+
+    return depth_at
+
+
+def array_body_forces(ms: CompiledMooring, r6s, current=None):
     """Net line forces on all bodies, flattened [6*nB]
     (== ms.bodyList[i].getForces(lines_only=True) stacked)."""
-    return _bodies_forces(ms, ms.params, jnp.asarray(r6s)).reshape(-1)
+    params = ms.params if current is None else params_with_current(ms, current)
+    return _bodies_forces(ms, params, jnp.asarray(r6s)).reshape(-1)
 
 
-def array_coupled_stiffness(ms: CompiledMooring, r6s):
+def array_coupled_stiffness(ms: CompiledMooring, r6s, current=None):
     """[6nB,6nB] stiffness -dF/dX of the array mooring system
     (== getCoupledStiffnessA(lines_only=True))."""
     r6s = jnp.asarray(r6s)
     shp = r6s.shape
 
     def f(xflat):
-        return array_body_forces(ms, xflat.reshape(shp))
+        return array_body_forces(ms, xflat.reshape(shp), current=current)
 
     return -jax.jacfwd(f)(r6s.reshape(-1))
 
 
-def array_tensions(ms: CompiledMooring, r6s):
+def array_tensions(ms: CompiledMooring, r6s, current=None):
     """Line end tensions [TA_1..TA_N, TB_1..TB_N] for the array system."""
-    pos = _equilibrium_positions(ms, ms.params, jnp.atleast_2d(jnp.asarray(r6s)))
-    _, _, TA, TB = _line_forces_at_points(ms, ms.params, pos)
+    params = ms.params if current is None else params_with_current(ms, current)
+    pos = _equilibrium_positions(ms, params, jnp.atleast_2d(jnp.asarray(r6s)))
+    _, _, TA, TB = _line_forces_at_points(ms, params, pos)
     return jnp.concatenate([TA, TB])
 
 
-def array_tension_jacobian(ms: CompiledMooring, r6s):
+def array_tension_jacobian(ms: CompiledMooring, r6s, current=None):
     """d tensions / d X [2*n_lines, 6nB] (== J_moor, raft_model.py:353)."""
     r6s = jnp.asarray(r6s)
     shp = r6s.shape
 
     def f(xflat):
-        return array_tensions(ms, xflat.reshape(shp))
+        return array_tensions(ms, xflat.reshape(shp), current=current)
 
     return jax.jacfwd(f)(r6s.reshape(-1))
 
 
 def compile_moordyn_file(path: str, depth: float, body_coords=None,
-                         rho=RHO_WATER, g=GRAVITY) -> CompiledMooring:
+                         rho=RHO_WATER, g=GRAVITY, bathymetry=None) -> CompiledMooring:
     """Parse a MoorDyn v2 input file into a multi-body CompiledMooring.
 
     Covers the array/farm shared-mooring path the reference delegates to
@@ -417,6 +531,11 @@ def compile_moordyn_file(path: str, depth: float, body_coords=None,
     coords; 'Free'; 'Fixed'), LINES, and the WtrDpth option.  Dynamics-
     only fields (BA, EI, NumSegs, dtM, ...) are ignored, as the
     quasi-static model has no use for them.
+
+    ``bathymetry``: optional callable (x, y) -> depth.  When given, each
+    line's seabed-contact flag uses the local depth at its lower end
+    instead of the uniform ``depth`` — the quasi-static effect of the
+    reference's array-level bathymetry file (raft_model.py:85-89).
     """
     with open(path) as f:
         raw_lines = [ln.rstrip("\n") for ln in f]
@@ -451,7 +570,12 @@ def compile_moordyn_file(path: str, depth: float, body_coords=None,
 
     ltypes = {}
     for p in data_rows("LINE TYPES"):
-        ltypes[p[0]] = {"d": float(p[1]), "m": float(p[2]), "EA": float(p[3])}
+        # MoorDyn v2 columns: Name Diam Mass/m EA BA/-zeta EI Cd Ca CdAx CaAx
+        ltypes[p[0]] = {
+            "d": float(p[1]), "m": float(p[2]), "EA": float(p[3]),
+            "Cd": float(p[6]) if len(p) > 6 else 0.0,
+            "CdAx": float(p[8]) if len(p) > 8 else 0.0,
+        }
 
     names, kinds, bodies, locs, masses, vols = [], [], [], [], [], []
     id_map = {}
@@ -474,7 +598,7 @@ def compile_moordyn_file(path: str, depth: float, body_coords=None,
         masses.append(float(p[5]) if len(p) > 5 else 0.0)
         vols.append(float(p[6]) if len(p) > 6 else 0.0)
 
-    iA, iB, Ls, EAs, ws, cbs = [], [], [], [], [], []
+    iA, iB, Ls, EAs, ws, cbs, ds, cdns, cdaxs = [], [], [], [], [], [], [], [], []
     for p in data_rows("LINES"):
         lt = ltypes[p[1]]
         a, b = id_map[p[2]], id_map[p[3]]
@@ -483,7 +607,12 @@ def compile_moordyn_file(path: str, depth: float, body_coords=None,
         Ls.append(float(p[4]))
         EAs.append(lt["EA"])
         ws.append(_submerged_weight(lt["d"], lt["m"], rho, g))
-        cbs.append(_seabed_cb(min(locs[a][2], locs[b][2]), depth))
+        lo = locs[a] if locs[a][2] <= locs[b][2] else locs[b]
+        local_depth = float(bathymetry(lo[0], lo[1])) if bathymetry is not None else depth
+        cbs.append(_seabed_cb(lo[2], local_depth))
+        ds.append(lt["d"])
+        cdns.append(lt["Cd"])
+        cdaxs.append(lt["CdAx"])
 
     n_bodies = (max((b for b in bodies if b >= 0), default=-1) + 1)
     if body_coords is not None:
@@ -498,6 +627,11 @@ def compile_moordyn_file(path: str, depth: float, body_coords=None,
         w=jnp.asarray(np.array(ws)),
         cb=jnp.asarray(np.array(cbs)),
         depth=jnp.asarray(float(depth)),
+        d_vol=jnp.asarray(np.array(ds)),
+        Cd_n=jnp.asarray(np.array(cdns)),
+        Cd_ax=jnp.asarray(np.array(cdaxs)),
+        current=jnp.zeros(3),
+        rho=jnp.asarray(float(rho)),
     )
     return CompiledMooring(
         n_points=len(names),
@@ -525,3 +659,22 @@ def fairlead_forces(ms: CompiledMooring, params: MooringParams, r6):
         if kinds[ms.line_iB[il]] == -1:
             mags.append(jnp.linalg.norm(F_B[il]))
     return jnp.stack(mags) if mags else jnp.zeros(0)
+
+
+# ---------------------------------------------------------------------------
+# jit caching
+# ---------------------------------------------------------------------------
+# The statics Newton loop re-solves the mooring equilibrium at every
+# step (raft_model.py:598-606); eagerly each call is hundreds of tiny
+# dispatches.  CompiledMooring hashes by topology (params excluded), so
+# jit with the system static caches one trace per mooring topology.
+# Only the functions that take params explicitly are wrapped — the
+# array_* helpers read ms.params internally, which a static-argument
+# cache would silently bake in as constants.
+
+point_positions = jax.jit(point_positions, static_argnums=0)
+body_forces = jax.jit(body_forces, static_argnums=0)
+coupled_stiffness = jax.jit(coupled_stiffness, static_argnums=0)
+tensions = jax.jit(tensions, static_argnums=0)
+tension_jacobian = jax.jit(tension_jacobian, static_argnums=0)
+fairlead_forces = jax.jit(fairlead_forces, static_argnums=0)

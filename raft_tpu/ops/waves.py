@@ -49,6 +49,12 @@ def wave_number(w, depth, tol=1e-3, max_iter=10_000):
     return k
 
 
+# jit: the while_loop otherwise rebuilds and compiles per call (~0.4 s),
+# and this runs in every Model/FOWT construction — tol/max_iter are
+# static so the trace caches on (shape, tol) only.
+wave_number = jax.jit(wave_number, static_argnums=(2, 3), static_argnames=("tol", "max_iter"))
+
+
 def wave_kinematics(zeta0, beta, w, k, depth, r, rho=RHO_WATER, g=GRAVITY):
     """First-order wave velocity/acceleration/dynamic-pressure amplitudes.
 

@@ -125,12 +125,19 @@ def design_params(fowt, include_aero=True, device=None):
 
 
 def make_parametric_solver(static, n_iter=15):
-    """Pure function solve(params, zeta, beta) -> Xi [nH,6,nw].
+    """Pure function solve(params, zeta, beta[, aero]) -> Xi [nH,6,nw].
 
     ``static`` is the second return of :func:`design_params` (python
     scalars baked into the trace); ``params`` carries every
     design-dependent array, so one jit of this function serves an
     entire design sweep via vmap over stacked params.
+
+    The optional 4th argument ``aero = {"A": [nw|1,6,6], "B": [nw|1,6,6]}``
+    adds the aero-servo impedance contributions of the CASE (wind-speed
+    dependent, design independent in a platform-geometry sweep — the
+    rotor is unchanged), so the (design, case) vmap axes stay factored:
+    params carries the platform, aero the operating point
+    (raft_model.py:905-914).
     """
     nw = static["nw"]
     depth = static["depth"]
@@ -143,7 +150,7 @@ def make_parametric_solver(static, n_iter=15):
     from ..ops import waves as waves_ops
     from ..ops import transforms
 
-    def solve(params, zeta, beta):
+    def solve(params, zeta, beta, aero=None):
         nodes = params["nodes"]
         w = params["w"]
         k = params["k"]
@@ -151,6 +158,9 @@ def make_parametric_solver(static, n_iter=15):
         M_const = params["M"]
         B_const = params["B"]
         C_const = params["C"]
+        if aero is not None:
+            M_const = M_const + aero["A"]
+            B_const = B_const + aero["B"]
 
         r_nodes = nodes["r"]  # [N,3]
         offs = r_nodes - prp

@@ -951,3 +951,24 @@ def _imat_mcf(topo, geom, pose, c, v_side, Imat_end, k_array, rho):
         Cm_p1[:, None, None, :] * p1M[None, :, :, None] + Cm_p2[:, None, None, :] * p2M[None, :, :, None]
     )
     return sides + Imat_end[:, :, :, None]
+
+
+# ---------------------------------------------------------------------------
+# jit caching
+# ---------------------------------------------------------------------------
+# The host Model layer calls these kernels per member, per Newton/drag
+# iteration; eagerly that is hundreds of tiny device dispatches per call
+# (~50 ms/member measured on CPU).  The topology is hashable and frozen,
+# so wrapping each kernel in jit with the topology static gives automatic
+# per-(topology, shapes) trace caching: the first call per topology
+# compiles one fused kernel, every later call — across drag iterations,
+# Newton steps, and design-sweep variants — is a cache hit.  vmap/grad
+# trace straight through the jit wrappers, so the batched design compiler
+# (parallel.design_batch) composes with them unchanged.
+
+member_pose = jax.jit(member_pose, static_argnums=0)
+member_inertia = jax.jit(member_inertia, static_argnums=0)
+member_hydrostatics = jax.jit(member_hydrostatics, static_argnums=0)
+member_hydro_constants = jax.jit(member_hydro_constants, static_argnums=0)
+node_coefficients = jax.jit(node_coefficients)
+node_volumes_areas = jax.jit(node_volumes_areas, static_argnums=0)

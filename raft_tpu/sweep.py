@@ -1,15 +1,19 @@
 """Design-sweep execution layer (parametersweep-equivalent, batched).
 
 The reference sweeps designs with serial nested for-loops re-running
-the full model per point (raft/parametersweep.py:56-100) — its prime
-TPU-sharding target (SURVEY.md §2.3).  Here a sweep runs as:
+the full model per point (raft/parametersweep.py:56-100).  Here a sweep
+runs end to end as array programs:
 
-1.  host loop compiling each design variant (geometry changes, same
-    topology → identical trace shapes, so the jitted case solver is
-    compiled ONCE and reused across all variants);
-2.  per design, the sea-state batch solves as one vmapped, mesh-sharded
-    device call (raft_tpu.parallel.CaseBatch);
-3.  response statistics reduce on device.
+1.  host probe-parsing learns which geometry/mooring leaves each sweep
+    axis touches and assembles the stacked [n_designs, ...] variant
+    batch with numpy indexing (raft_tpu.parallel.design_batch) — host
+    cost is O(n_axes x n_values), independent of the grid size;
+2.  ONE jitted call per chunk compiles every variant's physics (member
+    statics rollup, hydro constants, mooring stiffness) via a vmapped
+    design compiler and solves the whole (design x sea-state) batch,
+    with response statistics reduced on device;
+3.  axes outside the batched compiler's scope (turbine, site, settings,
+    topology changes) fall back to the per-variant model path.
 
 ``sweep`` mirrors the reference's mutate-design-dict pattern: you give
 a base design, a list of (path, values) axes, and get the full factorial
@@ -27,26 +31,14 @@ import jax.numpy as jnp
 
 from .core.model import Model
 from .ops import waves
+from .parallel.design_batch import SweepAxisError, set_in_design, stack_variants
 
-
-def set_in_design(design, path, value):
-    """Set a nested design-dict entry; path like
-    'platform.members.0.d' or a callable(design, value)."""
-    if callable(path):
-        path(design, value)
-        return
-    keys = path.split(".")
-    node = design
-    for k in keys[:-1]:
-        node = node[int(k)] if k.lstrip("-").isdigit() else node[k]
-    last = keys[-1]
-    if last.lstrip("-").isdigit():
-        node[int(last)] = value
-    else:
-        node[last] = value
+__all__ = ["sweep", "set_in_design", "case_aero_params"]
 
 
 def _compile_variant(base_design, axes, combo, device):
+    """Per-variant model path (fallback): build the full Model and
+    extract solver params eagerly."""
     from .parallel.case_solve import design_params
 
     design = copy.deepcopy(base_design)
@@ -61,8 +53,66 @@ def _compile_variant(base_design, axes, combo, device):
     return p, s, fowt
 
 
+def case_aero_params(fowt, wind_cases):
+    """Aero-servo impedance contributions per case, stacked.
+
+    Runs ``calcTurbineConstants`` on the template FOWT for each case dict
+    (wind_speed/turbulence/...; raft_fowt.py:773-845) and returns
+    ``{"A": [n_case, nw, 6, 6], "B": [n_case, nw, 6, 6]}`` — the terms a
+    platform-geometry sweep can factor out of the design axis because
+    the rotor/tower are unchanged across variants.
+    """
+    A_list, B_list = [], []
+    for case in wind_cases:
+        fowt.calcTurbineConstants(case, ptfm_pitch=0)
+        A_list.append(np.moveaxis(np.sum(fowt.A_aero, axis=3), 2, 0))
+        B_list.append(np.moveaxis(np.sum(fowt.B_aero, axis=3), 2, 0)
+                      + np.sum(fowt.B_gyro, axis=2)[None, :, :])
+    return {"A": jnp.asarray(np.stack(A_list)), "B": jnp.asarray(np.stack(B_list))}
+
+
+def _sea_state_waves(template, sea_states):
+    # zetas stay real here: the parametric solver casts to complex inside
+    # jit (the TPU plugin cannot transfer complex arrays eagerly)
+    w = jnp.asarray(template.w)
+    zl, bl = [], []
+    for ss in sea_states:
+        Hs, Tp = ss[0], ss[1]
+        beta = np.radians(ss[2]) if len(ss) > 2 else 0.0
+        S = waves.jonswap(w, Hs, Tp)
+        zl.append(jnp.sqrt(2.0 * S * template.dw))
+        bl.append(jnp.array([beta]))
+    return jnp.stack(zl)[:, None, :], jnp.stack(bl)
+
+
+def _sweep_signature(base_design, axes, combos, sea_states, n_iter, wind):
+    """Checkpoint identity: base design, axis PATHS (a callable axis repr
+    includes a per-process address, so such sweeps conservatively never
+    resume), exact value bytes (repr would elide large arrays;
+    non-numeric values hash via repr), sea states, wind cases, and the
+    iteration count."""
+    import hashlib
+
+    from .io_utils import clean_raft_dict
+
+    h = hashlib.sha256()
+    h.update(repr(clean_raft_dict(base_design)).encode())
+    h.update(repr([str(path) for path, _ in axes]).encode())
+    for combo in combos:
+        for v in combo:
+            try:
+                h.update(np.asarray(v, dtype=float).tobytes())
+            except (TypeError, ValueError):
+                h.update(repr(v).encode())
+    for s in sea_states:
+        h.update(np.asarray(s, dtype=float).tobytes())
+    h.update(str(n_iter).encode())
+    h.update(repr(wind).encode())
+    return h.hexdigest()
+
+
 def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
-          checkpoint=None, chunk_size=256):
+          checkpoint=None, chunk_size=256, wind=None):
     """Run a factorial design sweep.
 
     Parameters
@@ -73,6 +123,12 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         Design-variable axes; full factorial product is evaluated.
     sea_states : list of (Hs, Tp) or (Hs, Tp, heading_deg)
         Wave cases solved (batched) for every design variant.
+    wind : list of case dicts, optional
+        One reference-style case dict per sea state (wind_speed,
+        turbulence, ...).  Turns the aero-servo impedance ON: the rotor
+        contributions are computed once on the base design (the rotor is
+        unchanged by platform-geometry axes) and folded into each case's
+        solve (raft_model.py:905-914).
     checkpoint : str, optional
         Path to an .npz progress file.  Designs execute in chunks of
         ``chunk_size``; after each chunk the partial results are saved
@@ -86,49 +142,112 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
     dict with 'grid' (the factorial list of value tuples) and
     'motion_std' [n_designs, n_cases, 6] motion standard deviations.
     """
-    import hashlib
     import os
 
     from .parallel.case_solve import make_parametric_solver
+    from .parallel.design_batch import make_batch_compiler
 
     combos = list(itertools.product(*[v for _, v in axes]))
     n_designs = len(combos)
     n_cases = len(sea_states)
-    grid = combos
+    if wind is not None and len(wind) != n_cases:
+        raise ValueError("wind must align with sea_states (one case dict each)")
 
     results = np.full((n_designs, n_cases, 6), np.nan)
     done = np.zeros(n_designs, dtype=bool)
     sig = None
     if checkpoint:
-        # checkpoint identity covers the whole sweep definition: base
-        # design, axis PATHS (a callable axis repr includes a per-process
-        # address, so such sweeps conservatively never resume), exact
-        # value bytes (repr would elide large arrays; non-numeric values
-        # hash via repr), sea states, and the iteration count
-        h = hashlib.sha256()
-        from .io_utils import clean_raft_dict
-        h.update(repr(clean_raft_dict(base_design)).encode())
-        h.update(repr([str(path) for path, _ in axes]).encode())
-        for combo in combos:
-            for v in combo:
-                try:
-                    h.update(np.asarray(v, dtype=float).tobytes())
-                except (TypeError, ValueError):
-                    h.update(repr(v).encode())
-        for s in sea_states:
-            h.update(np.asarray(s, dtype=float).tobytes())
-        h.update(str(n_iter).encode())
-        sig = h.hexdigest()
-    if checkpoint and os.path.exists(checkpoint):
-        with np.load(checkpoint, allow_pickle=False) as dat:
-            if str(dat["sig"]) == sig and dat["motion_std"].shape == results.shape:
-                results = np.array(dat["motion_std"])
-                done = np.array(dat["done"])
-                if display:
-                    print(f"sweep resume: {int(done.sum())}/{n_designs} designs already done")
+        sig = _sweep_signature(base_design, axes, combos, sea_states, n_iter, wind)
+        if os.path.exists(checkpoint):
+            with np.load(checkpoint, allow_pickle=False) as dat:
+                if str(dat["sig"]) == sig and dat["motion_std"].shape == results.shape:
+                    results = np.array(dat["motion_std"])
+                    done = np.array(dat["done"])
+                    if display:
+                        print(f"sweep resume: {int(done.sum())}/{n_designs} designs already done")
+    if done.all():
+        return {"grid": combos, "motion_std": results}
 
+    # template model: frequency grid, rotors, mooring topology, fallback base.
+    # Only the rotors need positioning (RNA constants + aero); the member
+    # poses and mooring stiffness are traced inside the batch compiler, so
+    # a full setPosition here would just pay their jit compiles twice.
+    template_design = copy.deepcopy(base_design)
+    model = Model(template_design)
+    fowt = model.fowtList[0]
+    fowt.r6 = np.array([fowt.x_ref, fowt.y_ref, 0, 0, 0, 0], dtype=float)
+    for rot in fowt.rotorList:
+        rot.setPosition(r6=fowt.r6)
+
+    zetas, betas = _sea_state_waves(fowt, sea_states)
+    aero = case_aero_params(fowt, wind) if wind is not None else None
+
+    # ----- batched path: stacked geometry through one traced compiler -----
+    stacked = None
+    try:
+        compile_one, static = make_batch_compiler(fowt)
+        template_leaves = (
+            [jax.tree_util.tree_map(np.asarray, cm.geom) for cm in fowt.memberList],
+            jax.tree_util.tree_map(np.asarray, fowt.ms.params) if fowt.ms is not None else None,
+        )
+        stacked, treedef = stack_variants(
+            base_design, axes, combos, rho=fowt.rho_water, g=fowt.g,
+            x_ref=fowt.x_ref, y_ref=fowt.y_ref,
+            heading_adjust=fowt.heading_adjust,
+            reference_leaves=template_leaves, display=display,
+        )
+    except SweepAxisError as e:
+        if display:
+            print(f"sweep: falling back to per-variant model path ({e})")
+
+    if stacked is not None:
+        solve_p = make_parametric_solver(static, n_iter=n_iter)
+
+        if aero is None:
+            def chunk_fn(leaves, zetas, betas):
+                geoms, moor = jax.tree_util.tree_unflatten(treedef, leaves)
+                params = jax.vmap(compile_one)(geoms, moor)
+                Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
+                              in_axes=(0, None, None))(params, zetas, betas)
+                return jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1))
+        else:
+            def chunk_fn(leaves, zetas, betas, aero):
+                geoms, moor = jax.tree_util.tree_unflatten(treedef, leaves)
+                params = jax.vmap(compile_one)(geoms, moor)
+                Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0, 0)),
+                              in_axes=(0, None, None, None))(params, zetas, betas, aero)
+                return jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1))
+
+        jitted = jax.jit(chunk_fn)
+        chunk_size = min(chunk_size, n_designs)
+
+        for start in range(0, n_designs, chunk_size):
+            stop = min(start + chunk_size, n_designs)
+            if done[start:stop].all():
+                continue
+            # pad a short final chunk by repeating the last design so every
+            # chunk shares one leading shape (a second XLA compile would
+            # cost more than the padded rows; padded results are discarded)
+            n_real = stop - start
+            idx = np.arange(start, start + chunk_size)
+            idx[n_real:] = stop - 1
+            leaves = [jnp.asarray(lf[idx]) for lf in stacked]
+            if device is not None:
+                leaves = [jax.device_put(lf, device) for lf in leaves]
+            if aero is None:
+                std = jitted(leaves, zetas, betas)
+            else:
+                std = jitted(leaves, zetas, betas, aero)
+            results[start:stop] = np.asarray(std)[:n_real]
+            done[start:stop] = True
+            if display:
+                print(f"sweep: designs {start+1}-{stop}/{n_designs} done")
+            if checkpoint:
+                _save_checkpoint(checkpoint, sig, results, done)
+        return {"grid": combos, "motion_std": results}
+
+    # ----- fallback: per-variant model compile, batched device solve -----
     batched = None
-
     for start in range(0, n_designs, chunk_size):
         stop = min(start + chunk_size, n_designs)
         if done[start:stop].all():
@@ -141,38 +260,37 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             params_list.append(p)
             if display:
                 print(f"compiled design {ic+1}/{n_designs}: {combos[ic]}")
-        # pad a short final chunk by repeating the last design so every
-        # chunk shares one leading shape (a second XLA compile would cost
-        # more than the padded rows; padded results are discarded)
         n_real = len(params_list)
         if n_designs > chunk_size:
             params_list += [params_list[-1]] * (chunk_size - n_real)
 
         if batched is None:
             solve_p = make_parametric_solver(static, n_iter=n_iter)
-            # vmap axes: designs (params), then cases (waves) — one executable
-            batched = jax.jit(jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
-                                       in_axes=(0, None, None)))
-            w = jnp.asarray(template.w)
-            zl, bl = [], []
-            for ss in sea_states:
-                Hs, Tp = ss[0], ss[1]
-                beta = np.radians(ss[2]) if len(ss) > 2 else 0.0
-                S = waves.jonswap(w, Hs, Tp)
-                zl.append(jnp.sqrt(2.0 * S * template.dw) + 0j)
-                bl.append(jnp.array([beta]))
-            zetas = jnp.stack(zl)[:, None, :]
-            betas = jnp.stack(bl)
+            if aero is None:
+                batched = jax.jit(jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
+                                           in_axes=(0, None, None)))
+            else:
+                batched = jax.jit(jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0, 0)),
+                                           in_axes=(0, None, None, None)))
 
         params_stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
-        Xi = batched(params_stacked, zetas, betas)  # [chunk, ncase, 1, 6, nw]
+        if aero is None:
+            Xi = batched(params_stacked, zetas, betas)  # [chunk, ncase, 1, 6, nw]
+        else:
+            Xi = batched(params_stacked, zetas, betas, aero)
         results[start:stop] = np.asarray(
             jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1)))[:n_real]
         done[start:stop] = True
 
         if checkpoint:
-            tmp = f"{checkpoint}.{os.getpid()}.tmp.npz"  # .npz: savez keeps the name
-            np.savez(tmp, sig=sig, motion_std=results, done=done)
-            os.replace(tmp, checkpoint)
+            _save_checkpoint(checkpoint, sig, results, done)
 
-    return {"grid": grid, "motion_std": results}
+    return {"grid": combos, "motion_std": results}
+
+
+def _save_checkpoint(checkpoint, sig, results, done):
+    import os
+
+    tmp = f"{checkpoint}.{os.getpid()}.tmp.npz"  # .npz: savez keeps the name
+    np.savez(tmp, sig=sig, motion_std=results, done=done)
+    os.replace(tmp, checkpoint)

@@ -309,32 +309,32 @@ def test_sweep_checkpoint_resume(tmp_path):
                            checkpoint=ckpt, chunk_size=2)
     assert np.all(np.isfinite(out1["motion_std"]))
 
-    # resume: no designs left -> no compilation happens at all
+    # resume: no designs left -> no variant parsing/stacking at all
     calls = []
-    orig = sweep_mod._compile_variant
+    orig = sweep_mod.stack_variants
 
     def spy(*a, **k):
         calls.append(1)
         return orig(*a, **k)
 
-    sweep_mod._compile_variant = spy
+    sweep_mod.stack_variants = spy
     try:
         out2 = sweep_mod.sweep(design, axes, states, n_iter=6,
                                checkpoint=ckpt, chunk_size=2)
     finally:
-        sweep_mod._compile_variant = orig
+        sweep_mod.stack_variants = orig
     assert calls == []  # fully resumed from the checkpoint
     np.testing.assert_allclose(out2["motion_std"], out1["motion_std"])
 
     # a different sweep signature ignores the stale checkpoint
     calls.clear()
-    sweep_mod._compile_variant = spy
+    sweep_mod.stack_variants = spy
     try:
         out3 = sweep_mod.sweep(design, axes, [(5.0, 9.0)], n_iter=6,
                                checkpoint=ckpt, chunk_size=2)
     finally:
-        sweep_mod._compile_variant = orig
-    assert len(calls) == 3  # recomputed all designs
+        sweep_mod.stack_variants = orig
+    assert len(calls) == 1  # the variant batch was rebuilt and recomputed
     assert out3["motion_std"].shape == (3, 1, 6)
 
 
