@@ -19,13 +19,14 @@ def main():
     from raft_tpu.designs import demo_spar
     from raft_tpu.sweep import sweep
 
+    axes = [
+        ("platform.members.0.d", [[9.0] * 2 + [6.5] * 2, [9.4] * 2 + [6.5] * 2,
+                                  [10.0] * 2 + [6.5] * 2]),
+        ("platform.members.0.rho_fill", [[1700.0, 0, 0], [1900.0, 0, 0]]),
+    ]
     out = sweep(
         demo_spar(nw_freqs=(0.02, 0.6)),
-        axes=[
-            ("platform.members.0.d", [[9.0] * 2 + [6.5] * 2, [9.4] * 2 + [6.5] * 2,
-                                      [10.0] * 2 + [6.5] * 2]),
-            ("platform.members.0.rho_fill", [[1700.0, 0, 0], [1900.0, 0, 0]]),
-        ],
+        axes=axes,
         sea_states=[(4.0, 8.0), (6.0, 10.0), (9.0, 13.0)],
         display=1,
     )
@@ -35,6 +36,19 @@ def main():
     print(np.round(out["motion_std"][:, :, 0], 3))
     print("pitch std [rad] per design x sea state:")
     print(np.round(out["motion_std"][:, :, 4], 5))
+    print("platform mass [kg]:", np.round(out["mass"], 0))
+    print("displacement [m^3]:", np.round(out["displacement"], 1))
+    print("GM_T [m]:", np.round(out["GMT"], 2))
+
+    # reference-style contour postprocessing (parametersweep.py:119-561)
+    from raft_tpu.sweep_post import plot_sweep_contours
+
+    paths = plot_sweep_contours(
+        out, axes,
+        metrics=["mass", "GMT", "surge_std", "pitch_std"],
+        out_dir=".", prefix="example_sweep",
+    )
+    print("contour figures:", paths)
 
 
 if __name__ == "__main__":

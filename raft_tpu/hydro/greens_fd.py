@@ -115,12 +115,16 @@ def _pv_fd_numpy(R, s, K, h, k, kind, n_gauss=160):
     # PV of resJ/(mu-k) over the symmetric interval [0, 2k] vanishes
 
     # tail [2k, T]: slowest decay is e^{mu s} (kind 1, s->0) or
-    # e^{mu(|s|-2h)} (kind 2)
+    # e^{mu(|s|-2h)} (kind 2); like the deep-water rule, J0's
+    # self-cancellation truncates at ~600/R even when the exponential
+    # decay is slow (chunk-conservative: the largest per-point T)
     if kind == 1:
-        decay = np.minimum(np.max(s), -1e-3)
+        decay = np.minimum(s, -1e-3)
     else:
-        decay = np.max(np.abs(s)) - 2 * h
-    T = 2 * k + max(20.0, 40.0 / max(-decay, 0.15))
+        decay = np.abs(s) - 2 * h
+    T_decay = np.maximum(20.0, 40.0 / np.maximum(-decay, 0.15))
+    T_osc = np.maximum(20.0, 600.0 / np.maximum(R, 1e-6))
+    T = 2 * k + float(np.max(np.minimum(T_decay, T_osc)))
     T = min(T, 2 * k + 2000.0)
     R_max = float(np.max(R))
     panel = min(1.0, np.pi / (2.0 * max(R_max, 1e-6) + 1.0))

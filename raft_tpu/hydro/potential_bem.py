@@ -358,19 +358,25 @@ class PanelBEM:
                 dprof = ki * prof
             return jnp.asarray(prof), jnp.asarray(dprof)
 
+        def split(pair):
+            # jit outputs cross the device boundary as real arrays: the
+            # TPU plugin cannot transfer complex buffers eagerly
+            Fr, X = pair
+            return Fr.real, Fr.imag, X.real, X.imag
+
         @jax.jit
         def one_freq_deep(wi, ki, prof, dprof):
             S_w, D_w = self._wave_matrices(ki)
-            return radiate_and_excite(wi, ki, S_w, D_w, self.S0, self.D0,
-                                      prof, dprof)
+            return split(radiate_and_excite(wi, ki, S_w, D_w, self.S0, self.D0,
+                                            prof, dprof))
 
         @jax.jit
         def one_freq_fd(wi, ki, tabs, res_ch, res_sh, prof, dprof):
             S_w, D_w = self._wave_matrices_fd(ki, tabs, res_ch, res_sh)
             # the John kernel pairs with the bottom-image Rankine term
-            return radiate_and_excite(wi, ki, S_w, D_w,
-                                      self.S0 + self.S_bot,
-                                      self.D0 + self.D_bot, prof, dprof)
+            return split(radiate_and_excite(wi, ki, S_w, D_w,
+                                            self.S0 + self.S_bot,
+                                            self.D0 + self.D_bot, prof, dprof))
 
         for i in range(nw):
             wi, ki = float(w_np[i]), float(k_np[i])
@@ -388,14 +394,14 @@ class PanelBEM:
                 arg = np.minimum(tab.k * (z + self.depth), 300.0)
                 res_ch = jnp.asarray(np.sqrt(rc) * np.cosh(arg))
                 res_sh = jnp.asarray(np.sqrt(rc) * np.sinh(arg))
-                Fr, X = one_freq_fd(wi, ki, tab.jarrays(), res_ch, res_sh,
-                                    prof, dprof)
+                FrR, FrI, XR, XI = one_freq_fd(wi, ki, tab.jarrays(), res_ch,
+                                               res_sh, prof, dprof)
             else:
-                Fr, X = one_freq_deep(wi, ki, prof, dprof)
+                FrR, FrI, XR, XI = one_freq_deep(wi, ki, prof, dprof)
             # F = (i w A - B) v with unit velocity amplitude (e^{-i w t};
             # validated by the Haskind energy identity in tests/test_bem.py)
-            A_out[:, :, i] = np.imag(np.asarray(Fr)) / w_np[i]
-            B_out[:, :, i] = -np.real(np.asarray(Fr))
-            X_out[:, :, i] = np.asarray(X)
+            A_out[:, :, i] = np.asarray(FrI) / w_np[i]
+            B_out[:, :, i] = -np.asarray(FrR)
+            X_out[:, :, i] = np.asarray(XR) + 1j * np.asarray(XI)
 
         return A_out, B_out, X_out

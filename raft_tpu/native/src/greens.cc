@@ -230,10 +230,14 @@ void raft_pv_fd_points(const double* R, const double* s, int64_t n, double K,
           part1 += w * (integrand(mu) - resJ / (mu - k));
       }
 
-      // tail [2k, T] with oscillation-aware panels
+      // tail [2k, T] with oscillation-aware panels; like the deep-water
+      // rule, J0's self-cancellation truncates the slowly-decaying
+      // near-surface integrand at ~600/R even when e^{mu s} does not
       double decay = (kind == 1) ? std::min(sp, -1e-3)
                                  : std::abs(sp) - 2.0 * h;
-      double T = 2.0 * k + std::max(20.0, 40.0 / std::max(-decay, 0.15));
+      const double T_decay = std::max(20.0, 40.0 / std::max(-decay, 0.15));
+      const double T_osc = std::max(20.0, 600.0 / std::max(Rp, 1e-6));
+      double T = 2.0 * k + std::min(T_decay, T_osc);
       T = std::min(T, 2.0 * k + 2000.0);
       const double panel_len =
           std::min(1.0, M_PI / (2.0 * std::max(Rp, 1e-6) + 1.0));

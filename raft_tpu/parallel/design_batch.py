@@ -272,11 +272,18 @@ def make_batch_compiler(fowt):
 
     def compile_one(geoms, moor_params):
         """geoms: list over members of MemberGeometry; moor_params:
-        MooringParams or None.  Returns the parametric solver params."""
+        MooringParams or None.  Returns the parametric solver params,
+        plus a ``props`` entry of design properties (platform mass,
+        displacement, transverse metacentric height) matching the
+        quantities the reference sweep collects per point
+        (raft/parametersweep.py:9-54 getOutputs)."""
         M_struc = jnp.zeros((6, 6))
         m_center_sum = jnp.zeros(3)
         C_hydro = jnp.zeros((6, 6))
         A_hydro = jnp.zeros((6, 6))
+        VTOT = jnp.zeros(())
+        Sum_V_rCB = jnp.zeros(3)
+        IWPx = jnp.zeros(())
 
         node_parts = {k: [] for k in (
             "r", "q", "p1", "p2", "imat", "a_i", "Cd_q", "Cd_p1", "Cd_p2",
@@ -294,10 +301,13 @@ def make_batch_compiler(fowt):
                 M_struc = M_struc + jnp.sum(Mm, axis=0)
                 m_center_sum = m_center_sum + jnp.sum(center * mass[:, None], axis=0)
 
-            _, Cmat, _, _, _, _, _, _ = jax.vmap(
+            _, Cmat, V_UW, r_CB, AWP, IWP, xWP, yWP = jax.vmap(
                 lambda ge, po: mstruct.member_hydrostatics(topo, ge, po, rPRP=prp, rho=rho, g=g)
             )(geo, poses)
             C_hydro = C_hydro + jnp.sum(Cmat, axis=0)
+            VTOT = VTOT + jnp.sum(V_UW)
+            Sum_V_rCB = Sum_V_rCB + jnp.sum(V_UW[:, None] * r_CB, axis=0)
+            IWPx = IWPx + jnp.sum(IWP + AWP * yWP**2)
 
             k_arr = k_const if topo.mcf else None
             hydro = jax.vmap(
@@ -350,7 +360,19 @@ def make_batch_compiler(fowt):
             C_moor = jnp.zeros((6, 6))
         C = C_moor.at[5, 5].add(yawstiff) + C_struc + C_hydro
 
+        # design properties (getOutputs parity): GM_T = zCB + I_WPx/V - zCG;
+        # displacement is the displaced MASS rho*V [kg] like the reference's
+        # getOutputs (parametersweep.py:15, displ = fowt.V*1025)
+        Vsafe = jnp.where(VTOT > 0, VTOT, 1.0)
+        zCB = Sum_V_rCB[2] / Vsafe
+        props = {
+            "mass": m_all,
+            "displacement": rho * VTOT,
+            "GMT": zCB + IWPx / Vsafe - zCG,
+        }
+
         return {
+            "props": props,
             "nodes": nodes,
             "M": (M_struc + A_hydro)[None, :, :],
             "B": jnp.zeros((1, 6, 6)),

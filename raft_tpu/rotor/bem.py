@@ -348,8 +348,13 @@ def evaluate(rotor: BEMRotor, Uinf, Omega_radps, pitch_rad, tilt=0.0, yaw=0.0):
     """Rotor loads at one operating point (CCBlade.evaluate equivalent).
 
     Returns a dict with hub loads T, Y, Z, Q, My, Mz [N, N·m], power P,
-    and nondimensional coefficients; all averaged over ``n_sector``
-    azimuth positions.  Inputs in SI/rad.
+    and nondimensional coefficients.  Inputs in SI/rad.
+
+    Azimuthal treatment matches CCBlade's evaluate/thrusttorque exactly:
+    ONE blade is integrated at each of the ``n_sector`` sector azimuths
+    and the average is multiplied by the blade count (CCBlade does NOT
+    offset the other blades to their own azimuths), because the
+    reference's golden values embed that convention.
     """
     azimuths = jnp.arange(rotor.n_sector) * (2.0 * jnp.pi / rotor.n_sector)
 

@@ -139,8 +139,14 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
 
     Returns
     -------
-    dict with 'grid' (the factorial list of value tuples) and
-    'motion_std' [n_designs, n_cases, 6] motion standard deviations.
+    dict with 'grid' (the factorial list of value tuples),
+    'motion_std' [n_designs, n_cases, 6] motion standard deviations,
+    and per-design properties 'mass' [kg], 'displacement'
+    (displaced mass rho*V [kg], getOutputs convention), 'GMT' [m]
+    [n_designs] (the quantities the reference sweep's getOutputs
+    collects; NaN on the per-variant fallback path).  Feed the result
+    to :func:`raft_tpu.sweep_post.plot_sweep_contours` for the
+    reference-style contour figures (parametersweep.py:119-561).
     """
     import os
 
@@ -154,19 +160,23 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         raise ValueError("wind must align with sea_states (one case dict each)")
 
     results = np.full((n_designs, n_cases, 6), np.nan)
+    props = {k: np.full(n_designs, np.nan) for k in ("mass", "displacement", "GMT")}
     done = np.zeros(n_designs, dtype=bool)
     sig = None
     if checkpoint:
         sig = _sweep_signature(base_design, axes, combos, sea_states, n_iter, wind)
         if os.path.exists(checkpoint):
             with np.load(checkpoint, allow_pickle=False) as dat:
-                if str(dat["sig"]) == sig and dat["motion_std"].shape == results.shape:
+                if (str(dat["sig"]) == sig and dat["motion_std"].shape == results.shape
+                        and all(k in dat for k in props)):
                     results = np.array(dat["motion_std"])
                     done = np.array(dat["done"])
+                    for k in props:
+                        props[k] = np.array(dat[k])
                     if display:
                         print(f"sweep resume: {int(done.sum())}/{n_designs} designs already done")
     if done.all():
-        return {"grid": combos, "motion_std": results}
+        return {"grid": combos, "motion_std": results, **props}
 
     # template model: frequency grid, rotors, mooring topology, fallback base.
     # Only the rotors need positioning (RNA constants + aero); the member
@@ -197,6 +207,14 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             reference_leaves=template_leaves, display=display,
         )
     except SweepAxisError as e:
+        if wind is not None:
+            # the fallback exists for axes the batched compiler cannot
+            # express (turbine/site/settings/topology) — exactly the axes
+            # that would invalidate aero computed once on the base design
+            raise ValueError(
+                "wind-enabled sweeps need the batched design path; this "
+                f"axis set falls outside it ({e}). Sweep turbine/site axes "
+                "without `wind`, or via the full Model per point.") from e
         if display:
             print(f"sweep: falling back to per-variant model path ({e})")
 
@@ -207,16 +225,18 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             def chunk_fn(leaves, zetas, betas):
                 geoms, moor = jax.tree_util.tree_unflatten(treedef, leaves)
                 params = jax.vmap(compile_one)(geoms, moor)
+                pr = params.pop("props")
                 Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
                               in_axes=(0, None, None))(params, zetas, betas)
-                return jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1))
+                return jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1)), pr
         else:
             def chunk_fn(leaves, zetas, betas, aero):
                 geoms, moor = jax.tree_util.tree_unflatten(treedef, leaves)
                 params = jax.vmap(compile_one)(geoms, moor)
+                pr = params.pop("props")
                 Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0, 0)),
                               in_axes=(0, None, None, None))(params, zetas, betas, aero)
-                return jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1))
+                return jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1)), pr
 
         jitted = jax.jit(chunk_fn)
         chunk_size = min(chunk_size, n_designs)
@@ -235,16 +255,18 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             if device is not None:
                 leaves = [jax.device_put(lf, device) for lf in leaves]
             if aero is None:
-                std = jitted(leaves, zetas, betas)
+                std, pr = jitted(leaves, zetas, betas)
             else:
-                std = jitted(leaves, zetas, betas, aero)
+                std, pr = jitted(leaves, zetas, betas, aero)
             results[start:stop] = np.asarray(std)[:n_real]
+            for k in props:
+                props[k][start:stop] = np.asarray(pr[k])[:n_real]
             done[start:stop] = True
             if display:
                 print(f"sweep: designs {start+1}-{stop}/{n_designs} done")
             if checkpoint:
-                _save_checkpoint(checkpoint, sig, results, done)
-        return {"grid": combos, "motion_std": results}
+                _save_checkpoint(checkpoint, sig, results, done, props)
+        return {"grid": combos, "motion_std": results, **props}
 
     # ----- fallback: per-variant model compile, batched device solve -----
     batched = None
@@ -283,14 +305,14 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         done[start:stop] = True
 
         if checkpoint:
-            _save_checkpoint(checkpoint, sig, results, done)
+            _save_checkpoint(checkpoint, sig, results, done, props)
 
-    return {"grid": combos, "motion_std": results}
+    return {"grid": combos, "motion_std": results, **props}
 
 
-def _save_checkpoint(checkpoint, sig, results, done):
+def _save_checkpoint(checkpoint, sig, results, done, props):
     import os
 
     tmp = f"{checkpoint}.{os.getpid()}.tmp.npz"  # .npz: savez keeps the name
-    np.savez(tmp, sig=sig, motion_std=results, done=done)
+    np.savez(tmp, sig=sig, motion_std=results, done=done, **props)
     os.replace(tmp, checkpoint)

@@ -1,0 +1,147 @@
+"""Sweep postprocessing: reference-style contour figures over the grid.
+
+The reference's parametersweep postprocessing (raft/parametersweep.py:
+119-561) hand-writes a contourf panel for every pair of its five design
+variables, metric by metric, with the remaining variables held at a
+fixed index.  This module is the generic equivalent: given the factorial
+sweep result and the axes definition, it reshapes each metric onto the
+[n_1, ..., n_k] grid and emits one figure per metric containing every
+ordered axis pair (x-axis variable sweeping, y-axis variable sweeping,
+others at their middle value) — the same information layout as the
+reference's 4x4 panels, for any number of axes.
+"""
+
+from __future__ import annotations
+
+import os
+
+import numpy as np
+
+
+def _axis_label(path, i):
+    if callable(path):
+        name = getattr(path, "__name__", None)
+        return name if name and name != "<lambda>" else f"axis {i}"
+    return str(path).split(".")[-1] + f" [{path}]"
+
+
+def _axis_scalars(values):
+    """1-D scalar coordinate per axis value (contour axes need numbers);
+    vector-valued axis entries (e.g. a diameter list) plot by their
+    first element, falling back to the value index."""
+    out = []
+    for v in values:
+        a = np.asarray(v, dtype=object)
+        try:
+            out.append(float(np.asarray(v, dtype=float).ravel()[0]))
+        except (TypeError, ValueError):
+            out.append(float(len(out)))
+    return np.array(out)
+
+
+def grid_metric(out, axes, metric):
+    """Reshape a per-design metric onto the factorial grid.
+
+    ``metric``: name of a 1-D [n_designs] entry in the sweep result, or
+    an array.  Returns an array shaped [n_1, ..., n_k] following the
+    axes order (itertools.product ordering, as ``sweep`` produces).
+    """
+    vals = out[metric] if isinstance(metric, str) else metric
+    vals = np.asarray(vals)
+    shape = tuple(len(v) for _, v in axes)
+    return vals.reshape(shape + vals.shape[1:])
+
+
+def plot_sweep_contours(out, axes, metrics=None, out_dir=".", prefix="sweep",
+                        fixed_index=None):
+    """Write one all-pairs contour figure per metric.
+
+    Parameters
+    ----------
+    out : dict
+        Result of :func:`raft_tpu.sweep.sweep` (needs per-design arrays;
+        'motion_std' channels surge_std/.../yaw_std are derived
+        automatically, plus any of mass/displacement/GMT present).
+    axes : list of (path, values)
+        The axes the sweep ran with.
+    metrics : list of str, optional
+        Which metrics to plot; default = everything available.
+    fixed_index : list of int, optional
+        Index each non-plotted axis is held at (default: middle).
+
+    Returns the list of written figure paths.
+    """
+    import matplotlib
+
+    matplotlib.use("Agg")
+    import matplotlib.pyplot as plt
+
+    n_axes = len(axes)
+    if n_axes < 2:
+        raise ValueError("contour postprocessing needs at least two sweep axes")
+    coords = [_axis_scalars(v) for _, v in axes]
+    labels = [_axis_label(p, i) for i, (p, _) in enumerate(axes)]
+    if fixed_index is None:
+        fixed_index = [len(v) // 2 for _, v in axes]
+
+    # assemble available per-design metrics
+    fields = {}
+    ms = np.asarray(out["motion_std"])  # [nd, ncase, 6]
+    dof = ["surge", "sway", "heave", "roll", "pitch", "yaw"]
+    worst = ms.max(axis=1)  # worst sea state per design
+    for i, name in enumerate(dof):
+        fields[f"{name}_std"] = worst[:, i]
+    for key in ("mass", "displacement", "GMT"):
+        if key in out:
+            fields[key] = np.asarray(out[key])
+    if metrics is not None:
+        fields = {k: fields[k] for k in metrics}
+
+    paths = []
+    for name, vals in fields.items():
+        G = grid_metric(out, axes, vals)
+        fig, ax = plt.subplots(n_axes, n_axes,
+                               figsize=(4.5 * n_axes, 3.8 * n_axes),
+                               squeeze=False)
+        for iy in range(n_axes):
+            for ix in range(n_axes):
+                a = ax[iy][ix]
+                if ix == iy:
+                    # diagonal: 1-D cut along this axis
+                    idx = list(fixed_index)
+                    idx[ix] = slice(None)
+                    a.plot(coords[ix], G[tuple(idx)], "o-")
+                    a.set_xlabel(labels[ix])
+                    a.set_ylabel(name)
+                    continue
+                if len(coords[ix]) < 2 or len(coords[iy]) < 2:
+                    # contourf needs a 2x2 field; a single-value axis
+                    # degenerates this panel to the diagonal's 1-D cut
+                    one = ix if len(coords[ix]) >= 2 else iy
+                    idx = list(fixed_index)
+                    idx[one] = slice(None)
+                    if len(coords[one]) >= 2:
+                        a.plot(coords[one], G[tuple(idx)], "o-")
+                    a.set_xlabel(labels[one])
+                    a.set_ylabel(name)
+                    continue
+                idx = list(fixed_index)
+                idx[ix] = slice(None)
+                idx[iy] = slice(None)
+                F = G[tuple(idx)]
+                # F dims follow axis order; put iy on rows, ix on cols
+                if ix < iy:
+                    F = F.T
+                X, Y = np.meshgrid(coords[ix], coords[iy])
+                cf = a.contourf(X, Y, F)
+                fig.colorbar(cf, ax=a, label=name)
+                a.set_xlabel(labels[ix])
+                a.set_ylabel(labels[iy])
+        fig.suptitle(f"{name} over the design sweep "
+                     f"(other axes at index {fixed_index})")
+        fig.tight_layout()
+        path = os.path.join(out_dir, f"{prefix}_{name}.png")
+        fig.savefig(path, dpi=110)
+        plt.close(fig)
+        paths.append(path)
+    return paths

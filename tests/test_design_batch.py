@@ -129,3 +129,38 @@ def test_callable_axis():
     assert out["motion_std"].shape == (2, 1, 6)
     assert np.all(np.isfinite(out["motion_std"]))
     assert not np.allclose(out["motion_std"][0], out["motion_std"][1])
+
+
+def test_sweep_props_and_contours(tmp_path):
+    """Per-design properties (getOutputs parity: mass/displacement/GMT)
+    and the reference-style contour postprocessing
+    (raft/parametersweep.py:9-54, 119-561)."""
+    import os
+
+    from raft_tpu import sweep as sweep_mod
+    from raft_tpu.sweep_post import grid_metric, plot_sweep_contours
+
+    axes = [
+        ("platform.members.0.d",
+         [[9.4, 9.4, 6.5, 6.5], [10.2, 10.2, 6.5, 6.5]]),
+        ("platform.members.0.rho_fill", [[1700.0, 0, 0], [1900.0, 0, 0]]),
+    ]
+    out = sweep_mod.sweep(_demo(), axes, STATES[:1], n_iter=4)
+
+    for key in ("mass", "displacement", "GMT"):
+        assert np.all(np.isfinite(out[key])), key
+    G_mass = grid_metric(out, axes, "mass")
+    G_disp = grid_metric(out, axes, "displacement")
+    assert G_mass.shape == (2, 2)
+    # a fatter main column adds steel mass and displaced volume
+    assert np.all(G_mass[1] > G_mass[0])
+    assert np.all(G_disp[1] > G_disp[0])
+    # denser ballast adds mass but no displacement
+    assert np.all(G_mass[:, 1] > G_mass[:, 0])
+    np.testing.assert_allclose(G_disp[:, 1], G_disp[:, 0], rtol=1e-9)
+
+    paths = plot_sweep_contours(out, axes, metrics=["mass", "GMT", "surge_std"],
+                                out_dir=str(tmp_path))
+    assert len(paths) == 3
+    for p in paths:
+        assert os.path.getsize(p) > 10_000  # a real rendered figure
