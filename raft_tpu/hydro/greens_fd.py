@@ -31,13 +31,16 @@ F2 is smooth (its integrand decays like e^{mu(|w| - 2h)}).
 
 The reference reaches finite-depth radiation/diffraction by running the
 external Fortran HAMS solver (raft_fowt.py:623-650); this module is the
-TPU-native equivalent's finite-depth kernel.  Quadrature runs in the
-native C++ engine when available (raft_tpu/native), NumPy otherwise.
+TPU-native equivalent's finite-depth kernel.  Quadrature runs as one
+static-shape vectorized XLA program on an accelerator backend, and in
+the scalar native C++ engine (NumPy fallback) on the CPU backend where
+per-point adaptive panel counts beat SIMD on this host.
 """
 
 from __future__ import annotations
 
 import numpy as np
+import jax
 import jax.numpy as jnp
 from numpy.polynomial.legendre import leggauss
 from scipy.special import j0 as _j0
@@ -117,13 +120,16 @@ def _pv_fd_numpy(R, s, K, h, k, kind, n_gauss=160):
     # tail [2k, T]: slowest decay is e^{mu s} (kind 1, s->0) or
     # e^{mu(|s|-2h)} (kind 2); like the deep-water rule, J0's
     # self-cancellation truncates at ~600/R even when the exponential
-    # decay is slow (chunk-conservative: the largest per-point T)
+    # decay is slow (chunk-conservative: the largest per-point T).  The
+    # floor scales with k: mu is dimensional here, so an absolute floor
+    # would force wasted panels when k is small (see greens.cc).
     if kind == 1:
         decay = np.minimum(s, -1e-3)
     else:
         decay = np.abs(s) - 2 * h
-    T_decay = np.maximum(20.0, 40.0 / np.maximum(-decay, 0.15))
-    T_osc = np.maximum(20.0, 600.0 / np.maximum(R, 1e-6))
+    floorT = 4.0 * k
+    T_decay = np.maximum(floorT, 40.0 / np.maximum(-decay, 0.15))
+    T_osc = np.maximum(floorT, 600.0 / np.maximum(R, 1e-6))
     T = 2 * k + float(np.max(np.minimum(T_decay, T_osc)))
     T = min(T, 2 * k + 2000.0)
     R_max = float(np.max(R))
@@ -139,14 +145,124 @@ def _pv_fd_numpy(R, s, K, h, k, kind, n_gauss=160):
     return part1 + part2
 
 
-def _pv_fd(R, s, K, h, k, kind):
-    """Native C++ evaluation when available, NumPy otherwise."""
-    from .. import native
+_GAUSS160 = leggauss(160)
+_GAUSS8 = leggauss(8)
+# static tail panel count: the oscillation-resolution requirement
+# (panel width * R <= ~pi/2 with the 600/R truncation) bounds the worst
+# point near (T - 2k)(2R+1)/pi ~ 470 panels for EITHER kind — kind 2's
+# tail is short in mu only relative to 1/h, not to J0's period at large R
+_N_TAIL_PANELS = {1: 512, 2: 512}
+# one chunk covers a whole 192x128 table: each extra chunk costs a
+# host->device round trip (the axon tunnel adds ~100 ms per dispatch)
+_JNP_CHUNK = 24576
 
-    out = native.pv_fd_points(R, s, K, h, k, kind)
-    if out is not None:
-        return out
-    return _pv_fd_numpy(R, s, K, h, k, kind)
+
+def _pv_fd_jnp_impl(R, s, K, h, k, kind):
+    """Vectorized PV quadrature for one chunk of points (same rules as
+    the scalar paths, but with a per-point adaptive tail of FIXED panel
+    count so the whole chunk is one static-shape XLA program)."""
+    from ..ops import bessel
+
+    R = jnp.asarray(R)
+    s = jnp.asarray(s)
+
+    def integrand(mu, Rc, sc):
+        # overflow-safe form, as in _pv_fd_numpy
+        J = bessel.j0(mu * Rc)
+        X = jnp.exp(-2.0 * mu * h)
+        den = (mu - K) - (mu + K) * X
+        if kind == 1:
+            num = jnp.exp(mu * sc) + jnp.exp(-mu * (sc + 4 * h))
+            return ((mu + K) * num / den - jnp.exp(mu * sc)) * J
+        num = jnp.exp(-mu * (2 * h - sc)) + jnp.exp(-mu * (2 * h + sc))
+        return (mu + K) * num / den * J
+
+    Dp = jnp.sinh(k * h) + k * h * jnp.cosh(k * h) - K * h * jnp.sinh(k * h)
+    res_ch = jnp.cosh(k * (s + 2 * h)) if kind == 1 else jnp.cosh(k * s)
+    resJ = (k + K) * jnp.exp(-k * h) * res_ch / Dp * bessel.j0(k * R)
+
+    # regularized [0, 2k]
+    xg, wg = (jnp.asarray(_GAUSS160[0]), jnp.asarray(_GAUSS160[1]))
+    mu_g = (xg + 1.0) * k  # [160]
+    f_g = integrand(mu_g[None, :], R[:, None], s[:, None])
+    reg = f_g - resJ[:, None] / (mu_g[None, :] - k)
+    part1 = jnp.sum(reg * (wg * k)[None, :], axis=1)
+
+    # per-point tail length (same truncation rule as the scalar paths)
+    if kind == 1:
+        decay = jnp.minimum(s, -1e-3)
+    else:
+        decay = jnp.abs(s) - 2 * h
+    floorT = 4.0 * k
+    T_decay = jnp.maximum(floorT, 40.0 / jnp.maximum(-decay, 0.15))
+    T_osc = jnp.maximum(floorT, 600.0 / jnp.maximum(R, 1e-6))
+    T = 2.0 * k + jnp.minimum(jnp.minimum(T_decay, T_osc), 2000.0)
+
+    x8, w8 = (jnp.asarray(_GAUSS8[0]), jnp.asarray(_GAUSS8[1]))
+    n_panels = _N_TAIL_PANELS[kind]
+    width = (T - 2.0 * k) / n_panels  # [C]
+    centers = 2.0 * k + (jnp.arange(n_panels) + 0.5)[None, :] * width[:, None]
+    mu_t = centers[:, :, None] + 0.5 * width[:, None, None] * x8[None, None, :]
+    wt = 0.5 * width[:, None, None] * w8[None, None, :]
+    f_t = integrand(mu_t, R[:, None, None], s[:, None, None])
+    part2 = jnp.sum(f_t * wt, axis=(1, 2))
+    return part1 + part2
+
+
+_pv_fd_jnp_chunk = jax.jit(_pv_fd_jnp_impl, static_argnames=("kind",))
+
+# whole K-blocks per dispatch: host->device round trips (~100 ms each on
+# the axon tunnel) dominate a single table's build, so batching
+# frequencies is the difference between ~300 ms and ~30 ms per table
+_batchK_jits = {}
+
+
+def _pv_fd_jnp_batchK(R, s, Ks, h, ks, kind):
+    """[nK, n_points] PV values for a block of frequencies in ONE
+    dispatch (vmap over (K, k); point set and grids shared)."""
+    fn = _batchK_jits.get(kind)
+    if fn is None:
+        from functools import partial
+
+        fn = jax.jit(jax.vmap(partial(_pv_fd_jnp_impl, kind=kind),
+                              in_axes=(None, None, 0, None, 0)))
+        _batchK_jits[kind] = fn
+    return np.asarray(fn(jnp.asarray(R), jnp.asarray(s), jnp.asarray(Ks),
+                         h, jnp.asarray(ks)))
+
+
+def _pv_fd(R, s, K, h, k, kind):
+    """Vectorized jnp evaluation (default; one static-shape XLA program
+    per chunk).  ``RAFT_TPU_FD_QUAD=native|numpy`` selects the scalar
+    C++ / NumPy paths (kept for cross-validation, see test_native)."""
+    import os
+
+    default = "jnp" if jax.default_backend() != "cpu" else "native"
+    mode = os.environ.get("RAFT_TPU_FD_QUAD", default)
+    if mode == "native":
+        from .. import native
+
+        out = native.pv_fd_points(R, s, K, h, k, kind)
+        if out is not None:
+            return out
+        mode = "numpy"
+    if mode == "numpy":
+        return _pv_fd_numpy(R, s, K, h, k, kind)
+
+    R = np.asarray(R, dtype=float).ravel()
+    s = np.asarray(s, dtype=float).ravel()
+    n = len(R)
+    out = np.empty(n)
+    for i in range(0, n, _JNP_CHUNK):
+        Rc = R[i:i + _JNP_CHUNK]
+        sc = s[i:i + _JNP_CHUNK]
+        pad = _JNP_CHUNK - len(Rc)
+        if pad:  # keep one static shape -> one compiled program
+            Rc = np.concatenate([Rc, np.full(pad, 1.0)])
+            sc = np.concatenate([sc, np.full(pad, -1.0)])
+        vals = np.asarray(_pv_fd_jnp_chunk(Rc, sc, K, h, k, kind))
+        out[i:i + _JNP_CHUNK] = vals[: len(out) - i] if pad else vals
+    return out
 
 
 def _table_lookup(tab, R_max, frac_y, R):
@@ -180,6 +296,48 @@ def lookup_f2(tabs, R_max, h, R, w):
             _table_lookup(dF2_dw, R_max, wn, R))
 
 
+def _fd_grids(R_max_eff, h, n_R, n_s):
+    """Shared (R, u, w) table grids + flattened evaluation point sets.
+    The grids depend only on (R_max, h), so every frequency of one
+    geometry shares them (the basis of ``build_tables_batch``)."""
+    rl = np.linspace(0.0, 1.0, n_R)
+    R_grid = R_max_eff * rl**2          # clustered near 0
+    ul = np.linspace(0.0, 1.0, n_s)
+    u_grid = -2.0 * h * ul**2           # 0 .. -2h, clustered near 0
+    w_grid = h * np.linspace(0.0, 1.0, n_s)  # |z - zeta|
+
+    u_eval = np.minimum(u_grid, -1e-6 * max(h, 1.0))
+    Rg, Ug = np.meshgrid(R_grid, u_eval, indexing="ij")
+    pts1 = (Rg.ravel(), Ug.ravel())
+    Rg, Wg = np.meshgrid(R_grid, w_grid, indexing="ij")
+    pts2 = (Rg.ravel(), Wg.ravel())
+    return R_grid, u_grid, w_grid, pts1, pts2
+
+
+def build_tables_batch(Ks, h, R_max, n_R=192, n_s=128, block=4):
+    """Build GreenTableFD objects for many frequencies with K-blocked
+    single-dispatch quadrature (``_pv_fd_jnp_batchK``): on the tunneled
+    TPU each extra dispatch costs ~100 ms, so blocking frequencies is
+    what turns a 200-frequency finite-depth precompute into seconds.
+    Returns {K: GreenTableFD} (block=4 holds the [B, n_pts, panels, 8]
+    tail intermediate near 1.6 GB in f32).
+    """
+    Ks = [float(K) for K in Ks]
+    R_max_eff = float(R_max) * 1.02 + 1e-6
+    _, _, _, pts1, pts2 = _fd_grids(R_max_eff, h, n_R, n_s)
+    ks = [wavenumber(K, h) for K in Ks]
+    out = {}
+    for i in range(0, len(Ks), block):
+        Kb = np.asarray(Ks[i:i + block])
+        kb = np.asarray(ks[i:i + block])
+        F1b = _pv_fd_jnp_batchK(pts1[0], pts1[1], Kb, float(h), kb, 1)
+        F2b = _pv_fd_jnp_batchK(pts2[0], pts2[1], Kb, float(h), kb, 2)
+        for j, K in enumerate(Kb):
+            out[float(K)] = GreenTableFD(K, h, R_max, n_R=n_R, n_s=n_s,
+                                         _precomputed=(F1b[j], F2b[j]))
+    return out
+
+
 class GreenTableFD:
     """Per-frequency finite-depth wave-part tables with device lookup.
 
@@ -188,25 +346,22 @@ class GreenTableFD:
     the deep-water GreenTable.
     """
 
-    def __init__(self, K, h, R_max, n_R=192, n_s=128):
+    def __init__(self, K, h, R_max, n_R=192, n_s=128, _precomputed=None):
         self.K = float(K)
         self.h = float(h)
         self.k = wavenumber(K, h)
         self.R_max = float(R_max) * 1.02 + 1e-6
 
-        rl = np.linspace(0.0, 1.0, n_R)
-        self.R_grid = self.R_max * rl**2          # clustered near 0
-        ul = np.linspace(0.0, 1.0, n_s)
-        self.u_grid = -2.0 * h * ul**2            # 0 .. -2h, clustered near 0
-        self.w_grid = h * np.linspace(0.0, 1.0, n_s)  # |z - zeta|
+        (self.R_grid, self.u_grid, self.w_grid,
+         pts1, pts2) = _fd_grids(self.R_max, h, n_R, n_s)
 
-        u_eval = np.minimum(self.u_grid, -1e-6 * max(h, 1.0))
-        Rg, Ug = np.meshgrid(self.R_grid, u_eval, indexing="ij")
-        F1 = _pv_fd(Rg.ravel(), Ug.ravel(), self.K, h, self.k, 1)
-        self.F1 = F1.reshape(n_R, n_s)
-        Rg, Wg = np.meshgrid(self.R_grid, self.w_grid, indexing="ij")
-        F2 = _pv_fd(Rg.ravel(), Wg.ravel(), self.K, h, self.k, 2)
-        self.F2 = F2.reshape(n_R, n_s)
+        if _precomputed is not None:
+            F1, F2 = _precomputed
+        else:
+            F1 = _pv_fd(pts1[0], pts1[1], self.K, h, self.k, 1)
+            F2 = _pv_fd(pts2[0], pts2[1], self.K, h, self.k, 2)
+        self.F1 = np.asarray(F1).reshape(n_R, n_s)
+        self.F2 = np.asarray(F2).reshape(n_R, n_s)
 
         def grads(F, yg):
             dR = np.gradient(F, axis=0) / np.gradient(self.R_grid)[:, None]

@@ -206,6 +206,34 @@ class PanelBEM:
             self._fd_tables[key] = GreenTableFD(K, self.depth, R_max)
         return self._fd_tables[key]
 
+    def prebuild_fd_tables(self, w):
+        """Build the finite-depth Green tables for a whole frequency grid
+        with K-blocked single-dispatch quadrature (greens_fd.
+        build_tables_batch) — the fast path for 100+-frequency runs; the
+        per-frequency lazy `_fd_table` path stays as-is for small grids.
+        No-op for deep water or frequencies the solver treats as deep
+        (kh >= 6)."""
+        if self.depth is None:
+            return
+        from .greens_fd import build_tables_batch, wavenumber
+
+        Ks = []
+        for wi in np.atleast_1d(np.asarray(w, dtype=float)):
+            K = wi**2 / self.g
+            key = round(float(K), 10)
+            if key in self._fd_tables:
+                continue
+            if wavenumber(K, self.depth) * self.depth < 6.0:
+                Ks.append(K)
+        if not Ks:
+            return
+        R_max = float(np.max(np.asarray(self.Rh)))
+        tabs = build_tables_batch(Ks, self.depth, R_max)
+        self._FD_CACHE_MAX = max(self._FD_CACHE_MAX,
+                                 len(tabs) + len(self._fd_tables) + 8)
+        for K, tab in tabs.items():
+            self._fd_tables[round(float(K), 10)] = tab
+
     def _orient_normals(self):
         """Ensure normals point out of the body (into the fluid): for the
         wetted surface closed by the z=0 lid, the divergence theorem gives
@@ -293,6 +321,13 @@ class PanelBEM:
         k_np = np.asarray(k)
         nw = len(w_np)
         heads = np.radians(np.asarray(headings_deg, dtype=float))
+
+        # many-frequency finite-depth runs: batch-build the Green tables
+        # (one dispatch per K-block) instead of ~2 dispatches per table.
+        # On the CPU backend the scalar native path is faster per table,
+        # so the lazy per-frequency route stays.
+        if self.depth is not None and nw > 8 and jax.default_backend() != "cpu":
+            self.prebuild_fd_tables(w_np)
 
         A_out = np.zeros([6, 6, nw])
         B_out = np.zeros([6, 6, nw])
@@ -403,5 +438,12 @@ class PanelBEM:
             A_out[:, :, i] = np.asarray(FrI) / w_np[i]
             B_out[:, :, i] = -np.asarray(FrR)
             X_out[:, :, i] = np.asarray(XR) + 1j * np.asarray(XI)
+
+        # release prebuilt Green tables beyond the steady-state cap so a
+        # big grid doesn't leave hundreds of MB of device arrays parked
+        # on an idle solver object
+        self._FD_CACHE_MAX = PanelBEM._FD_CACHE_MAX
+        while len(self._fd_tables) > self._FD_CACHE_MAX:
+            self._fd_tables.pop(next(iter(self._fd_tables)))
 
         return A_out, B_out, X_out

@@ -232,11 +232,16 @@ void raft_pv_fd_points(const double* R, const double* s, int64_t n, double K,
 
       // tail [2k, T] with oscillation-aware panels; like the deep-water
       // rule, J0's self-cancellation truncates the slowly-decaying
-      // near-surface integrand at ~600/R even when e^{mu s} does not
+      // near-surface integrand at ~600/R even when e^{mu s} does not.
+      // The floor scales with k (the kernel's own scale): mu is
+      // DIMENSIONAL here, so the deep rule's absolute floor of 20 (fine
+      // in t = mu/K units) would force ~1000 wasted panels per point
+      // when k ~ 0.05 and the integrand is long dead.
       double decay = (kind == 1) ? std::min(sp, -1e-3)
                                  : std::abs(sp) - 2.0 * h;
-      const double T_decay = std::max(20.0, 40.0 / std::max(-decay, 0.15));
-      const double T_osc = std::max(20.0, 600.0 / std::max(Rp, 1e-6));
+      const double floorT = 4.0 * k;
+      const double T_decay = std::max(floorT, 40.0 / std::max(-decay, 0.15));
+      const double T_osc = std::max(floorT, 600.0 / std::max(Rp, 1e-6));
       double T = 2.0 * k + std::min(T_decay, T_osc);
       T = std::min(T, 2.0 * k + 2000.0);
       const double panel_len =

@@ -222,3 +222,53 @@ def test_irr_removal_suppresses_interior_resonance():
     assert err_plain > 0.15          # the resonance is visible without the lid
     assert err_irr < 0.6 * err_plain  # and substantially suppressed with it
     assert 0.3 < A_irr[2, 2, 0] / (RHO * HEMI_V) < 0.6  # physics still sane
+
+
+def test_fd_quadrature_paths_agree():
+    """The three finite-depth PV quadrature paths — vectorized jnp
+    (accelerator default), native C++, NumPy — agree on random points.
+    The jnp path uses per-point fixed-count tails, the scalar paths
+    adaptive counts, so agreement is to quadrature tolerance.  Lives
+    here (not test_native) so it runs even without a C++ toolchain —
+    the "native" mode then falls back to NumPy internally."""
+    import os
+
+    import numpy as np
+
+    from raft_tpu.hydro import greens_fd
+
+    K, h = 0.05, 200.0
+    k = greens_fd.wavenumber(K, h)
+    rng = np.random.default_rng(0)
+    R = rng.uniform(0.0, 80.0, 300)
+    u = -rng.uniform(0.0, 2 * h, 300)
+    w = rng.uniform(0.0, h, 300)
+
+    def run(mode):
+        prev = os.environ.get("RAFT_TPU_FD_QUAD")
+        os.environ["RAFT_TPU_FD_QUAD"] = mode
+        try:
+            return (greens_fd._pv_fd(R, u, K, h, k, 1),
+                    greens_fd._pv_fd(R, w, K, h, k, 2))
+        finally:
+            if prev is None:
+                del os.environ["RAFT_TPU_FD_QUAD"]
+            else:
+                os.environ["RAFT_TPU_FD_QUAD"] = prev
+
+    j1, j2 = run("jnp")
+    n1, n2 = run("native")
+    p1, p2 = run("numpy")
+    s1 = np.max(np.abs(p1))
+    s2 = np.max(np.abs(p2))
+    assert np.max(np.abs(j1 - p1)) < 1e-3 * s1
+    assert np.max(np.abs(n1 - p1)) < 1e-3 * s1
+    assert np.max(np.abs(j2 - p2)) < 1e-6 * s2
+    assert np.max(np.abs(n2 - p2)) < 1e-6 * s2
+
+    # the K-blocked batch builder produces well-formed tables (full
+    # batch-vs-single equality is checked on the accelerator path)
+    tabs = greens_fd.build_tables_batch([0.04, 0.07], h, 80.0)
+    for K_, tab in tabs.items():
+        arrs = tab.jarrays()
+        assert all(np.all(np.isfinite(np.asarray(a))) for a in arrs)

@@ -78,3 +78,4 @@ def test_pv_fd_matches_numpy():
         nat = native.pv_fd_points(R, s, K, h, k, kind)
         ref = gfd._pv_fd_numpy(R, s, K, h, k, kind)
         np.testing.assert_allclose(nat, ref, atol=1e-10)
+
