@@ -13,10 +13,22 @@ from .schema import get_from_dict  # noqa: F401
 def __getattr__(name):
     # Lazy import so that `import raft_tpu` stays cheap and so ops-level
     # test environments don't pay for the full model stack.
-    if name in ("Model", "runRAFT", "runRAFTFarm"):
+    if name in ("Model", "runRAFTFarm"):
         try:
             from .core import model as _model
         except ImportError as e:
             raise AttributeError(f"raft_tpu.{name} unavailable: {e}") from e
         return getattr(_model, name)
+    if name == "runRAFT":
+        # like the reference package layout, raft_tpu.runRAFT is the
+        # legacy driver MODULE (reference raft/runRAFT.py); the modern
+        # entry point function is raft_tpu.core.model.runRAFT.
+        # (importlib directly: `from . import runRAFT` would re-enter
+        # this __getattr__ through _handle_fromlist and recurse)
+        import importlib
+
+        try:
+            return importlib.import_module(".runRAFT", __name__)
+        except ImportError as e:
+            raise AttributeError(f"raft_tpu.runRAFT unavailable: {e}") from e
     raise AttributeError(name)

@@ -322,7 +322,13 @@ def build_tables_batch(Ks, h, R_max, n_R=192, n_s=128, block=4):
     Returns {K: GreenTableFD} (block=4 holds the [B, n_pts, panels, 8]
     tail intermediate near 1.6 GB in f32).
     """
+    import os
+
     Ks = [float(K) for K in Ks]
+    if os.environ.get("RAFT_TPU_FD_QUAD", "jnp") != "jnp":
+        # cross-validation knob forces a scalar path: build per frequency
+        # through _pv_fd so the env var keeps meaning what it says
+        return {K: GreenTableFD(K, h, R_max, n_R=n_R, n_s=n_s) for K in Ks}
     R_max_eff = float(R_max) * 1.02 + 1e-6
     _, _, _, pts1, pts2 = _fd_grids(R_max_eff, h, n_R, n_s)
     ks = [wavenumber(K, h) for K in Ks]

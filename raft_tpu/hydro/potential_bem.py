@@ -413,37 +413,39 @@ class PanelBEM:
                                             self.S0 + self.S_bot,
                                             self.D0 + self.D_bot, prof, dprof))
 
-        for i in range(nw):
-            wi, ki = float(w_np[i]), float(k_np[i])
-            prof, dprof = incident_profile(ki)
-            # per-frequency kernel choice: John tables in the finite-depth
-            # regime; beyond kh ~ 6 the deep-water kernel matches to 0.1%
-            # (see tests) and costs no per-frequency table build
-            if self.depth is not None and ki * self.depth < 6.0:
-                from .greens_fd import residue_coef
+        try:
+            for i in range(nw):
+                wi, ki = float(w_np[i]), float(k_np[i])
+                prof, dprof = incident_profile(ki)
+                # per-frequency kernel choice: John tables in the finite-depth
+                # regime; beyond kh ~ 6 the deep-water kernel matches to 0.1%
+                # (see tests) and costs no per-frequency table build
+                if self.depth is not None and ki * self.depth < 6.0:
+                    from .greens_fd import residue_coef
 
-                tab = self._fd_table(wi**2 / self.g)
-                self._fd_Rmax = tab.R_max
-                rc = residue_coef(tab.K, self.depth, tab.k)
-                z = np.asarray(self._Ce[:, 2])  # body + lid assembly set
-                arg = np.minimum(tab.k * (z + self.depth), 300.0)
-                res_ch = jnp.asarray(np.sqrt(rc) * np.cosh(arg))
-                res_sh = jnp.asarray(np.sqrt(rc) * np.sinh(arg))
-                FrR, FrI, XR, XI = one_freq_fd(wi, ki, tab.jarrays(), res_ch,
-                                               res_sh, prof, dprof)
-            else:
-                FrR, FrI, XR, XI = one_freq_deep(wi, ki, prof, dprof)
-            # F = (i w A - B) v with unit velocity amplitude (e^{-i w t};
-            # validated by the Haskind energy identity in tests/test_bem.py)
-            A_out[:, :, i] = np.asarray(FrI) / w_np[i]
-            B_out[:, :, i] = -np.asarray(FrR)
-            X_out[:, :, i] = np.asarray(XR) + 1j * np.asarray(XI)
+                    tab = self._fd_table(wi**2 / self.g)
+                    self._fd_Rmax = tab.R_max
+                    rc = residue_coef(tab.K, self.depth, tab.k)
+                    z = np.asarray(self._Ce[:, 2])  # body + lid assembly set
+                    arg = np.minimum(tab.k * (z + self.depth), 300.0)
+                    res_ch = jnp.asarray(np.sqrt(rc) * np.cosh(arg))
+                    res_sh = jnp.asarray(np.sqrt(rc) * np.sinh(arg))
+                    FrR, FrI, XR, XI = one_freq_fd(wi, ki, tab.jarrays(), res_ch,
+                                                   res_sh, prof, dprof)
+                else:
+                    FrR, FrI, XR, XI = one_freq_deep(wi, ki, prof, dprof)
+                # F = (i w A - B) v with unit velocity amplitude (e^{-i w t};
+                # validated by the Haskind energy identity in tests/test_bem.py)
+                A_out[:, :, i] = np.asarray(FrI) / w_np[i]
+                B_out[:, :, i] = -np.asarray(FrR)
+                X_out[:, :, i] = np.asarray(XR) + 1j * np.asarray(XI)
 
-        # release prebuilt Green tables beyond the steady-state cap so a
-        # big grid doesn't leave hundreds of MB of device arrays parked
-        # on an idle solver object
-        self._FD_CACHE_MAX = PanelBEM._FD_CACHE_MAX
-        while len(self._fd_tables) > self._FD_CACHE_MAX:
-            self._fd_tables.pop(next(iter(self._fd_tables)))
+        finally:
+            # release prebuilt Green tables beyond the steady-state cap so
+            # a big grid doesn't leave hundreds of MB of device arrays
+            # parked on an idle solver object, even when a solve fails
+            self._FD_CACHE_MAX = PanelBEM._FD_CACHE_MAX
+            while len(self._fd_tables) > self._FD_CACHE_MAX:
+                self._fd_tables.pop(next(iter(self._fd_tables)))
 
         return A_out, B_out, X_out

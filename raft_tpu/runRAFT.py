@@ -1,0 +1,86 @@
+"""Legacy standalone driver (reference runRAFT.py parity, deprecated).
+
+The reference ships an older driver module (`raft/runRAFT.py`) predating
+`raft_model.runRAFT`: it loads a design YAML, disables potential-flow
+members, builds a fixed 0.05..5 rad/s frequency grid, runs the model for
+one default environment, and plots.  Its `loadTurbineYAML` converts an
+IEA-ontology turbine YAML into the RAFT turbine dict (runRAFT.py:67-259)
+and `runRAFTfromWEIS` is a stub wired to WEIS glue (runRAFT.py:261-420).
+
+This module reproduces that surface on the modern API.  Prefer
+``raft_tpu.Model`` / ``raft_tpu.core.model.runRAFT`` for new work — each
+entry point emits a DeprecationWarning, like the docstring guidance the
+reference gives.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+import yaml
+
+
+def runRAFT(fname_design, fname_turbine=None, fname_env=None, plot=False):
+    """Standalone legacy run: design YAML in, analyzed Model out
+    (reference runRAFT.py:21-64).
+
+    Follows the legacy flow: potMod forced off on every member, fixed
+    w = 0.05..5 rad/s grid, one default environment (Hs=8, Tp=12,
+    V=10 m/s), eigen solve, statics, and the dynamic response.
+    ``fname_turbine``/``fname_env`` are accepted for signature parity;
+    like the reference (whose turbine-merge line is commented out,
+    runRAFT.py:42-44), the design file is the single source of truth.
+    """
+    warnings.warn("runRAFT.runRAFT is the deprecated legacy driver; use "
+                  "raft_tpu.core.model.runRAFT(design_yaml)", DeprecationWarning)
+    from .core.model import Model
+
+    with open(fname_design) as f:
+        design = yaml.load(f, Loader=yaml.FullLoader)
+    print("Loading file: " + fname_design)
+    print(f"'{design['name']}'")
+
+    # legacy behavior: no BEM analysis, fixed frequency grid
+    for mi in design["platform"]["members"]:
+        mi["potMod"] = False
+    design.setdefault("settings", {})
+    design["settings"]["min_freq"] = 0.05 / (2 * np.pi)
+    design["settings"]["max_freq"] = 5.0 / (2 * np.pi)
+
+    # the legacy default environment (runRAFT.py:50: Hs=8, Tp=12, V=10)
+    design["cases"] = {
+        "keys": ["wind_speed", "wind_heading", "turbulence", "turbine_status",
+                 "yaw_misalign", "wave_spectrum", "wave_period", "wave_height",
+                 "wave_heading"],
+        "data": [[10.0, 0.0, 0.0, "operating", 0.0, "JONSWAP", 12.0, 8.0, 0.0]],
+    }
+
+    model = Model(design)
+    model.analyzeUnloaded()
+    model.solveEigen()
+    model.analyzeCases()
+    if plot:
+        model.plot()
+    return model
+
+
+def loadTurbineYAML(fname_turbine, n_span=30):
+    """IEA-ontology turbine YAML -> RAFT turbine dict
+    (reference runRAFT.py:67-259, which goes through wisdem's schema
+    loader; here the framework's own converter does the parse)."""
+    warnings.warn("runRAFT.loadTurbineYAML is deprecated; use "
+                  "raft_tpu.io_utils.convert_iea_turbine_yaml", DeprecationWarning)
+    from .io_utils import convert_iea_turbine_yaml
+
+    print("Loading turbine YAML file: " + str(fname_turbine))
+    return convert_iea_turbine_yaml(fname_turbine, n_span=n_span)
+
+
+def runRAFTfromWEIS(*args, **kwargs):
+    """WEIS-driven entry stub (reference runRAFT.py:261-420 builds its
+    design dict from WEIS glue objects).  The supported WEIS boundary in
+    this framework is the OMDAO component."""
+    raise NotImplementedError(
+        "runRAFTfromWEIS is a WEIS-internal stub in the reference; use "
+        "raft_tpu.omdao.RAFT_OMDAO / RAFT_Group as the WEIS boundary.")

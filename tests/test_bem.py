@@ -268,7 +268,7 @@ def test_fd_quadrature_paths_agree():
 
     # the K-blocked batch builder produces well-formed tables (full
     # batch-vs-single equality is checked on the accelerator path)
-    tabs = greens_fd.build_tables_batch([0.04, 0.07], h, 80.0)
+    tabs = greens_fd.build_tables_batch([0.04, 0.07], h, 80.0, n_R=32, n_s=24)
     for K_, tab in tabs.items():
         arrs = tab.jarrays()
         assert all(np.all(np.isfinite(np.asarray(a))) for a in arrs)
