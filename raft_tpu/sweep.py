@@ -141,7 +141,10 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
     -------
     dict with 'grid' (the factorial list of value tuples),
     'motion_std' [n_designs, n_cases, 6] motion standard deviations,
-    and per-design properties 'mass' [kg], 'displacement'
+    'AxRNA_std' [n_designs, n_cases] nacelle fore-aft acceleration
+    standard deviations (batched path; the saveTurbineOutputs channel
+    the WEIS Max_Nacelle_Acc aggregate reads), and per-design
+    properties 'mass' [kg], 'displacement'
     (displaced mass rho*V [kg], getOutputs convention), 'GMT' [m]
     [n_designs] (the quantities the reference sweep's getOutputs
     collects; NaN on the per-variant fallback path).  Feed the result
@@ -160,6 +163,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         raise ValueError("wind must align with sea_states (one case dict each)")
 
     results = np.full((n_designs, n_cases, 6), np.nan)
+    nacelle_acc = np.full((n_designs, n_cases), np.nan)
     props = {k: np.full(n_designs, np.nan) for k in ("mass", "displacement", "GMT")}
     done = np.zeros(n_designs, dtype=bool)
     sig = None
@@ -176,7 +180,8 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                     if display:
                         print(f"sweep resume: {int(done.sum())}/{n_designs} designs already done")
     if done.all():
-        return {"grid": combos, "motion_std": results, **props}
+        return {"grid": combos, "motion_std": results,
+                "AxRNA_std": nacelle_acc, **props}
 
     # template model: frequency grid, rotors, mooring topology, fallback base.
     # Only the rotors need positioning (RNA constants + aero); the member
@@ -220,6 +225,17 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
 
     if stacked is not None:
         solve_p = make_parametric_solver(static, n_iter=n_iter)
+        # nacelle position for the acceleration channel (constant across
+        # platform-geometry variants, like the rotor itself)
+        z_hub = float(fowt.rotorList[0].r3[2]) if fowt.rotorList else 0.0
+        w_j = jnp.asarray(fowt.w)
+
+        def _metrics(Xi):
+            std = jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1))
+            # nacelle fore-aft acceleration amplitude: -w^2 (xi1 + z_hub*xi5)
+            a_nac = (w_j**2) * (Xi[:, :, 0, 0, :] + z_hub * Xi[:, :, 0, 4, :])
+            a_std = jnp.sqrt(0.5 * jnp.sum(jnp.abs(a_nac) ** 2, axis=-1))
+            return std, a_std
 
         if aero is None:
             def chunk_fn(leaves, zetas, betas):
@@ -228,7 +244,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 pr = params.pop("props")
                 Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
                               in_axes=(0, None, None))(params, zetas, betas)
-                return jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1)), pr
+                return _metrics(Xi), pr
         else:
             def chunk_fn(leaves, zetas, betas, aero):
                 geoms, moor = jax.tree_util.tree_unflatten(treedef, leaves)
@@ -236,7 +252,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 pr = params.pop("props")
                 Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0, 0)),
                               in_axes=(0, None, None, None))(params, zetas, betas, aero)
-                return jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1)), pr
+                return _metrics(Xi), pr
 
         jitted = jax.jit(chunk_fn)
         chunk_size = min(chunk_size, n_designs)
@@ -255,10 +271,11 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             if device is not None:
                 leaves = [jax.device_put(lf, device) for lf in leaves]
             if aero is None:
-                std, pr = jitted(leaves, zetas, betas)
+                (std, a_std), pr = jitted(leaves, zetas, betas)
             else:
-                std, pr = jitted(leaves, zetas, betas, aero)
+                (std, a_std), pr = jitted(leaves, zetas, betas, aero)
             results[start:stop] = np.asarray(std)[:n_real]
+            nacelle_acc[start:stop] = np.asarray(a_std)[:n_real]
             for k in props:
                 props[k][start:stop] = np.asarray(pr[k])[:n_real]
             done[start:stop] = True
@@ -266,7 +283,8 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 print(f"sweep: designs {start+1}-{stop}/{n_designs} done")
             if checkpoint:
                 _save_checkpoint(checkpoint, sig, results, done, props)
-        return {"grid": combos, "motion_std": results, **props}
+        return {"grid": combos, "motion_std": results,
+                "AxRNA_std": nacelle_acc, **props}
 
     # ----- fallback: per-variant model compile, batched device solve -----
     batched = None
@@ -307,7 +325,10 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         if checkpoint:
             _save_checkpoint(checkpoint, sig, results, done, props)
 
-    return {"grid": combos, "motion_std": results, **props}
+    # the per-variant path reports the motion response only (AxRNA/props
+    # stay NaN, same keys as the batched path)
+    return {"grid": combos, "motion_std": results,
+            "AxRNA_std": nacelle_acc, **props}
 
 
 def _save_checkpoint(checkpoint, sig, results, done, props):

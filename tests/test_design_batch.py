@@ -164,3 +164,17 @@ def test_sweep_props_and_contours(tmp_path):
     assert len(paths) == 3
     for p in paths:
         assert os.path.getsize(p) > 10_000  # a real rendered figure
+
+
+def test_sweep_nacelle_acceleration_channel():
+    """AxRNA_std: nacelle fore-aft acceleration std per (design, case) —
+    the saveTurbineOutputs channel WEIS's Max_Nacelle_Acc reads
+    (raft_fowt.py:1930-1945), reduced on device in the batched sweep."""
+    from raft_tpu import sweep as sweep_mod
+
+    out = sweep_mod.sweep(_demo(), AXES, STATES, n_iter=4)
+    a = out["AxRNA_std"]
+    assert a.shape == (2, 2)
+    assert np.all(np.isfinite(a)) and np.all(a > 0)
+    # rougher sea state -> larger nacelle acceleration for every design
+    assert np.all(a[:, 1] > a[:, 0])
