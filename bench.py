@@ -13,8 +13,9 @@ impedance.  The north star is < 60 s for the full sweep (BASELINE.json),
 so ``vs_baseline`` = 60 / measured_seconds.
 
 ``detail`` also reports the marginal cost of a second full sweep() call
-in the same process.  Both numbers are compile-dominated: the pure
-device runtime of the 1000x12 solve is <1 s on one chip.
+in the same process, which reuses the compiled executables through the
+sweep template memo (the cold number is compile-dominated: the pure
+device runtime of the 1000x12 solve is <1 s on one chip).
 """
 
 import json
@@ -73,8 +74,8 @@ def main():
         assert np.all(np.isfinite(out["motion_std"])), "sweep produced non-finite metrics"
 
         # repeat = marginal cost of ANOTHER full sweep() call in-process
-        # (closures re-jit, so this is still compile-dominated; the pure
-        # device runtime of the solve is <1 s — see detail)
+        # (the sweep template memo reuses the compiled executables, so
+        # this is probe-parse + stacking + device runtime)
         t0 = time.perf_counter()
         out2 = sweep(design, axes, states, n_iter=15, device=accel, wind=wind,
                      chunk_size=250)

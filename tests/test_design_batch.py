@@ -178,3 +178,38 @@ def test_sweep_nacelle_acceleration_channel():
     assert np.all(np.isfinite(a)) and np.all(a > 0)
     # rougher sea state -> larger nacelle acceleration for every design
     assert np.all(a[:, 1] > a[:, 0])
+
+
+def test_sweep_template_memoization():
+    """Repeat sweeps of the same base design reuse the compiled template
+    (model + batched compiler + chunk executable): the second call must
+    not rebuild the design compiler, and new axis values still give
+    correct, distinct results through the cached executable."""
+    from raft_tpu import sweep as sweep_mod
+    from raft_tpu.parallel import design_batch
+
+    design = _demo()
+    calls = []
+    orig = design_batch.make_batch_compiler
+
+    def spy(fowt):
+        calls.append(1)
+        return orig(fowt)
+
+    design_batch.make_batch_compiler = spy
+    try:
+        sweep_mod._TEMPLATE_MEMO.clear()
+        out1 = sweep_mod.sweep(design, AXES, STATES, n_iter=4)
+        axes2 = [(AXES[0][0], [[9.6, 9.6, 6.5, 6.5], [10.4, 10.4, 6.5, 6.5]])]
+        out2 = sweep_mod.sweep(design, axes2, STATES, n_iter=4)
+        assert len(calls) == 1  # compiler built once, reused on the repeat
+        assert np.all(np.isfinite(out2["motion_std"]))
+        assert not np.allclose(out1["motion_std"], out2["motion_std"])
+        # a different design content misses the memo and compiles fresh
+        d3 = _demo()
+        d3["platform"]["members"][0]["t"] = 0.06
+        sweep_mod.sweep(d3, AXES, STATES[:1], n_iter=4)
+        assert len(calls) == 2
+        assert len(sweep_mod._TEMPLATE_MEMO) == 2
+    finally:
+        design_batch.make_batch_compiler = orig
