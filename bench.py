@@ -35,7 +35,12 @@ def main():
             jax.config.update("jax_platforms", platforms + ",cpu")
     except Exception:
         pass
+    from raft_tpu.config import enable_compilation_cache
     from raft_tpu.sweep import sweep
+
+    # persistent compile cache: a cold process deserializes the sweep
+    # executables (~56 s of XLA compile otherwise; see config.py)
+    enable_compilation_cache()
 
     accel = jax.devices()[0]
     try:

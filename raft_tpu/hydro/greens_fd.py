@@ -120,8 +120,11 @@ def _pv_fd_numpy(R, s, K, h, k, kind, n_gauss=160):
     # tail [2k, T]: slowest decay is e^{mu s} (kind 1, s->0) or
     # e^{mu(|s|-2h)} (kind 2); like the deep-water rule, J0's
     # self-cancellation truncates at ~600/R even when the exponential
-    # decay is slow (chunk-conservative: the largest per-point T).  The
-    # floor scales with k: mu is dimensional here, so an absolute floor
+    # decay is slow.  T, the panel width, and the panel count are all
+    # PER POINT, matching greens.cc exactly (a chunk-wide max-T grid
+    # differs from the scalar rule by ~1e-5 when a chunk mixes a
+    # near-surface small-R point with a large-R point).  The floor
+    # scales with k: mu is dimensional here, so an absolute floor
     # would force wasted panels when k is small (see greens.cc).
     if kind == 1:
         decay = np.minimum(s, -1e-3)
@@ -130,18 +133,29 @@ def _pv_fd_numpy(R, s, K, h, k, kind, n_gauss=160):
     floorT = 4.0 * k
     T_decay = np.maximum(floorT, 40.0 / np.maximum(-decay, 0.15))
     T_osc = np.maximum(floorT, 600.0 / np.maximum(R, 1e-6))
-    T = 2 * k + float(np.max(np.minimum(T_decay, T_osc)))
-    T = min(T, 2 * k + 2000.0)
-    R_max = float(np.max(R))
-    panel = min(1.0, np.pi / (2.0 * max(R_max, 1e-6) + 1.0))
-    n_panels = int(np.ceil((T - 2 * k) / panel))
-    edges = np.linspace(2 * k, T, n_panels + 1)
+    T = 2 * k + np.minimum(np.minimum(T_decay, T_osc), 2000.0)  # [n]
+    panel = np.minimum(1.0, np.pi / (2.0 * np.maximum(R, 1e-6) + 1.0))
+    n_panels = np.ceil((T - 2 * k) / panel).astype(np.int64)  # [n]
+    hp = (T - 2 * k) / n_panels  # [n]
     xg, wg = leggauss(8)
-    mids = 0.5 * (edges[1:] + edges[:-1])
-    half = 0.5 * (edges[1:] - edges[:-1])
-    tt = (mids[:, None] + half[:, None] * xg[None, :]).ravel()
-    ww = (half[:, None] * wg[None, :]).ravel()
-    part2 = np.sum(integrand(tt) * ww[None, :], axis=1)
+    pidx = np.arange(int(n_panels.max()))  # [P]
+    mid = 2 * k + (pidx[None, :] + 0.5) * hp[:, None]  # [n,P]
+    half = 0.5 * hp[:, None, None]
+    tt = mid[:, :, None] + half * xg[None, None, :]  # [n,P,8]
+    ww = np.where(pidx[None, :, None] < n_panels[:, None, None],
+                  half * wg[None, None, :], 0.0)
+    # integrand with per-point mu grids (padded panels weight 0)
+    J = _j0(tt * R[:, None, None])
+    X = np.exp(-2.0 * tt * h)
+    den = (tt - K) - (tt + K) * X
+    sc = s[:, None, None]
+    if kind == 1:
+        num = np.exp(tt * sc) + np.exp(-tt * (sc + 4 * h))
+        f_t = ((tt + K) * num / den - np.exp(tt * sc)) * J
+    else:
+        num = np.exp(-tt * (2 * h - sc)) + np.exp(-tt * (2 * h + sc))
+        f_t = (tt + K) * num / den * J
+    part2 = np.sum(f_t * ww, axis=(1, 2))
     return part1 + part2
 
 

@@ -101,7 +101,8 @@ def _member_qtf(topo, geom, pose, w2nd, k2nd, beta, depth, Xi, rho, g,
     PmatCa = (Ca_p1[:, None, None] * p1M + Ca_p2[:, None, None] * p2M)
 
     # ----- first-order fields on the 2nd-order grid -----
-    ones = jnp.ones(nw2, dtype=jnp.complex128)
+    cdtype = jnp.complex128 if w2nd.dtype == jnp.float64 else jnp.complex64
+    ones = jnp.ones(nw2, dtype=cdtype)
     u_n, _, _ = waves_ops.wave_kinematics(ones, beta, w2nd, k2nd, depth, r, rho=rho, g=g)
     u_n = jnp.transpose(u_n, (2, 0, 1))  # [nw2, N, 3]
     u_n = u_n * wet[None, :, None]

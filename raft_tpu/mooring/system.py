@@ -275,7 +275,12 @@ def _line_forces_at_points(ms: CompiledMooring, params: MooringParams, pos):
     zhat_t = -f_d / w_eff[:, None]
     up = jnp.zeros_like(zhat_t).at[:, 2].set(1.0)
     zhat = jnp.where(contact[:, None], up, zhat_t)
-    w_line = jnp.where(contact, params.w - q[:, 2], w_eff)
+    # clamp the contact-frame effective weight to a positive floor: a
+    # steep contact chord in strong current can drive w - q_z through
+    # zero, and the catenary solver divides by w (LB = L - VF/w)
+    w_line = jnp.where(contact,
+                       jnp.maximum(params.w - q[:, 2], 1e-3 * params.w),
+                       w_eff)
 
     # lo->hi frame (by effective-vertical separation) for the 2-D solver
     swap = jnp.sum(d3 * zhat, axis=1) < 0.0

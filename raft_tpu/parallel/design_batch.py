@@ -73,8 +73,9 @@ class SweepAxisError(ValueError):
 
 def _parse_variant(design, rho, g, x_ref=0.0, y_ref=0.0, heading_adjust=0.0):
     """Numpy leaf list for one design variant: member geometries followed
-    by mooring params, plus a static signature that must match across
-    variants."""
+    by mooring params, a static signature that must match across
+    variants, and a separate turbine signature (turbine changes are
+    batchable as the per-variant aero/RNA axis, not a hard refusal)."""
     from ..core.fowt import compile_member_list
 
     design = copy.deepcopy(design)
@@ -96,19 +97,26 @@ def _parse_variant(design, rho, g, x_ref=0.0, y_ref=0.0, heading_adjust=0.0):
         repr(design.get("site", {})),
         repr(design.get("settings", {})),
         repr(design.get("turbine", {}).get("tower", None)),
-        repr({k: v for k, v in design.get("turbine", {}).items()
-              if k not in ("tower", "nacelle", "blade", "airfoils")}),
     )
-    return leaves, treedef, sig
+    # everything else in the turbine dict (blade/airfoils/control gains/
+    # hub geometry/RNA masses) feeds the rotor build, not the platform
+    # geometry leaves — a sweep axis touching only this is an AERO axis
+    turb_sig = repr({k: v for k, v in design.get("turbine", {}).items()
+                     if k != "tower"})
+    return leaves, treedef, sig, turb_sig
 
 
 def stack_variants(base_design, axes, combos, rho, g, x_ref=0.0, y_ref=0.0,
                    heading_adjust=0.0, reference_leaves=None, display=0):
     """Assemble the stacked leaf batch for every axis-value combination.
 
-    Returns (stacked_leaves, treedef) where each stacked leaf has a
-    leading [n_designs] axis.  Raises :class:`SweepAxisError` when an
-    axis changes the static signature (topology/turbine/site/settings).
+    Returns (stacked_leaves, treedef, aero_axes) where each stacked leaf
+    has a leading [n_designs] axis and ``aero_axes`` lists the indices
+    of axes that change ONLY the turbine dict (rotor aero / control /
+    RNA — the caller batches those through per-variant aero params, see
+    sweep.py).  Raises :class:`SweepAxisError` when an axis changes the
+    static signature (topology/site/settings/tower) or mixes turbine
+    and geometry changes.
 
     ``reference_leaves``: optional leaf list for the UNMUTATED design as
     the caller's model actually built it (template FOWT geometry +
@@ -118,7 +126,8 @@ def stack_variants(base_design, axes, combos, rho, g, x_ref=0.0, y_ref=0.0,
     must not trust the batch.
     """
     n_designs = len(combos)
-    leaves0, treedef, sig0 = _parse_variant(base_design, rho, g, x_ref, y_ref, heading_adjust)
+    leaves0, treedef, sig0, turb_sig0 = _parse_variant(
+        base_design, rho, g, x_ref, y_ref, heading_adjust)
     if reference_leaves is not None:
         ref, ref_def = jax.tree_util.tree_flatten(reference_leaves)
         if (ref_def != treedef or len(ref) != len(leaves0)
@@ -132,31 +141,42 @@ def stack_variants(base_design, axes, combos, rho, g, x_ref=0.0, y_ref=0.0,
         d = copy.deepcopy(base_design)
         for (path, _), val in zip(axes, combo):
             set_in_design(d, path, val)
-        leaves, td, sig = _parse_variant(d, rho, g, x_ref, y_ref, heading_adjust)
+        leaves, td, sig, _ = _parse_variant(d, rho, g, x_ref, y_ref, heading_adjust)
         if sig != sig0:
             raise SweepAxisError(
-                "sweep axis changes member topology, turbine, site, or "
-                "settings — not expressible as a batched-geometry axis"
+                "sweep axis changes member topology, site, settings, or "
+                "tower — not expressible as a batched-geometry axis"
             )
         return leaves
 
     # probe each axis independently at each of its values
     touched = []  # per axis: {leaf_idx: [value_0_leaf, value_1_leaf, ...]}
+    aero_axes = []
     for ia, (path, values) in enumerate(axes):
         ax_touch = {}
+        ax_turb = False
         for iv, v in enumerate(values):
             d = copy.deepcopy(base_design)
             set_in_design(d, path, v)
-            leaves, _, sig = _parse_variant(d, rho, g, x_ref, y_ref, heading_adjust)
+            leaves, _, sig, turb_sig = _parse_variant(d, rho, g, x_ref, y_ref, heading_adjust)
             if sig != sig0:
                 raise SweepAxisError(
-                    f"sweep axis {path!r} changes member topology, turbine, "
-                    "site, or settings — not expressible as a batched-"
+                    f"sweep axis {path!r} changes member topology, site, "
+                    "settings, or tower — not expressible as a batched-"
                     "geometry axis"
                 )
+            ax_turb = ax_turb or (turb_sig != turb_sig0)
             for il, (a, b) in enumerate(zip(leaves0, leaves)):
                 if not np.array_equal(a, b):
                     ax_touch.setdefault(il, [np.asarray(x) for x in [a] * len(values)])[iv] = b
+        if ax_turb:
+            if ax_touch:
+                raise SweepAxisError(
+                    f"sweep axis {path!r} changes both the turbine dict and "
+                    "platform geometry/mooring — cannot factor it into the "
+                    "(geometry batch x aero variant) decomposition"
+                )
+            aero_axes.append(ia)
         touched.append(ax_touch)
 
     # cross-axis interaction on a shared leaf -> exact per-combination parse
@@ -179,7 +199,7 @@ def stack_variants(base_design, axes, combos, rho, g, x_ref=0.0, y_ref=0.0,
             print("sweep: cross-axis leaf interaction detected; parsing every combination")
         all_leaves = [parse_combo(c) for c in combos]
         stacked = [np.stack([lv[il] for lv in all_leaves]) for il in range(len(leaves0))]
-        return stacked, treedef
+        return stacked, treedef, aero_axes
 
     stacked = []
     for il, leaf0 in enumerate(leaves0):
@@ -190,9 +210,20 @@ def stack_variants(base_design, axes, combos, rho, g, x_ref=0.0, y_ref=0.0,
         else:
             stacked.append(np.broadcast_to(np.asarray(leaf0)[None], (n_designs,) + np.shape(leaf0)))
 
-    # spot-check two designs against a direct parse; a miss means an
-    # interaction the probes could not see -> use the exact path
-    for ic in {n_designs // 2, n_designs - 1}:
+    # spot-check designs against a direct parse; a miss means an
+    # interaction the probes could not see -> use the exact path.  Two
+    # fixed indices plus a random sample seeded from the combo values
+    # (deterministic per sweep, but different sweeps check different
+    # combos — a value-dependent interaction that happens to match at
+    # fixed indices cannot hide from every sweep's sample)
+    import zlib
+
+    spot = {n_designs // 2, n_designs - 1}
+    seed = zlib.crc32(np.ascontiguousarray(idx, dtype=np.int64).tobytes())
+    rng = np.random.default_rng(seed)
+    spot.update(int(i) for i in rng.choice(n_designs, size=min(4, n_designs),
+                                           replace=False))
+    for ic in spot:
         ref = parse_combo(combos[ic])
         ok = all(np.allclose(stacked[il][ic], ref[il], rtol=0, atol=0, equal_nan=True)
                  for il in range(len(ref)))
@@ -201,9 +232,9 @@ def stack_variants(base_design, axes, combos, rho, g, x_ref=0.0, y_ref=0.0,
                 print("sweep: probe assembly failed a spot check; parsing every combination")
             all_leaves = [parse_combo(c) for c in combos]
             stacked = [np.stack([lv[il] for lv in all_leaves]) for il in range(len(leaves0))]
-            return stacked, treedef
+            return stacked, treedef, aero_axes
 
-    return stacked, treedef
+    return stacked, treedef, aero_axes
 
 
 def _vkey(v):
@@ -215,6 +246,27 @@ def _vkey(v):
 # ---------------------------------------------------------------------------
 # device: batched design -> solver params
 # ---------------------------------------------------------------------------
+
+
+def rna_params_for(fowt):
+    """Stacked RNA mass-property pytree for one FOWT's rotors — the
+    turbine-side quantities the batch compiler folds into M_struc
+    (raft_fowt.py:467-480).  Turbine sweep axes stack these per aero
+    variant and pass them through ``compile_one``'s ``rna`` argument."""
+    nrot = len(fowt.rotorList)
+    rna = {
+        "Mdiag": np.zeros((nrot, 6, 6)),
+        "R_q": np.zeros((nrot, 3, 3)),
+        "r_CG_rel": np.zeros((nrot, 3)),
+        "mRNA": np.zeros(nrot),
+    }
+    for ir, rot in enumerate(fowt.rotorList):
+        rna["Mdiag"][ir] = np.diag([rot.mRNA, rot.mRNA, rot.mRNA,
+                                    rot.IxRNA, rot.IrRNA, rot.IrRNA])
+        rna["R_q"][ir] = np.asarray(rot.R_q)
+        rna["r_CG_rel"][ir] = np.asarray(rot.r_CG_rel)
+        rna["mRNA"][ir] = rot.mRNA
+    return jax.tree_util.tree_map(jnp.asarray, rna)
 
 
 def make_batch_compiler(fowt):
@@ -260,23 +312,19 @@ def make_batch_compiler(fowt):
     yawstiff = fowt.yawstiff
     ms = fowt.ms
 
-    rna = [
-        (
-            jnp.asarray(np.diag([rot.mRNA, rot.mRNA, rot.mRNA, rot.IxRNA, rot.IrRNA, rot.IrRNA])),
-            jnp.asarray(np.asarray(rot.R_q)),
-            jnp.asarray(np.asarray(rot.r_CG_rel)),
-            float(rot.mRNA),
-        )
-        for rot in fowt.rotorList
-    ]
+    rna_template = rna_params_for(fowt)
 
-    def compile_one(geoms, moor_params):
+    def compile_one(geoms, moor_params, rna=None):
         """geoms: list over members of MemberGeometry; moor_params:
-        MooringParams or None.  Returns the parametric solver params,
-        plus a ``props`` entry of design properties (platform mass,
-        displacement, transverse metacentric height) matching the
+        MooringParams or None; rna: optional per-variant RNA property
+        pytree (see :func:`rna_params_for`) for turbine sweep axes —
+        defaults to the template rotor's.  Returns the parametric solver
+        params, plus a ``props`` entry of design properties (platform
+        mass, displacement, transverse metacentric height) matching the
         quantities the reference sweep collects per point
         (raft/parametersweep.py:9-54 getOutputs)."""
+        if rna is None:
+            rna = rna_template
         M_struc = jnp.zeros((6, 6))
         m_center_sum = jnp.zeros(3)
         C_hydro = jnp.zeros((6, 6))
@@ -345,10 +393,10 @@ def make_batch_compiler(fowt):
         nodes = {k: jnp.concatenate(v, axis=0) for k, v in node_parts.items()}
 
         # RNA contributions (raft_fowt.py:467-480)
-        for Mdiag, R_q, r_CG_rel, mRNA in rna:
-            Mmat = transforms.rotate_matrix6(Mdiag, R_q)
-            M_struc = M_struc + transforms.translate_matrix_6to6(Mmat, r_CG_rel)
-            m_center_sum = m_center_sum + r_CG_rel * mRNA
+        for ir in range(rna["mRNA"].shape[0]):
+            Mmat = transforms.rotate_matrix6(rna["Mdiag"][ir], rna["R_q"][ir])
+            M_struc = M_struc + transforms.translate_matrix_6to6(Mmat, rna["r_CG_rel"][ir])
+            m_center_sum = m_center_sum + rna["r_CG_rel"][ir] * rna["mRNA"][ir]
 
         m_all = M_struc[0, 0]
         zCG = m_center_sum[2] / m_all

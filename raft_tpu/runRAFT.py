@@ -84,3 +84,25 @@ def runRAFTfromWEIS(*args, **kwargs):
     raise NotImplementedError(
         "runRAFTfromWEIS is a WEIS-internal stub in the reference; use "
         "raft_tpu.omdao.RAFT_OMDAO / RAFT_Group as the WEIS boundary.")
+
+
+# Round 1 exported the MODERN driver function as `raft_tpu.runRAFT`; this
+# module (the reference's legacy-module layout) took that name in round 2.
+# Calling the module keeps round-1 callers working: it forwards to the
+# modern function with a DeprecationWarning instead of raising
+# "'module' object is not callable".
+class _CallableLegacyModule(type(warnings)):
+    def __call__(self, *args, **kwargs):
+        warnings.warn(
+            "calling raft_tpu.runRAFT(...) as a function is the round-1 "
+            "API; it now forwards to raft_tpu.core.model.runRAFT. "
+            "(raft_tpu.runRAFT the MODULE is the legacy driver, matching "
+            "the reference package layout.)", DeprecationWarning)
+        from .core.model import runRAFT as _modern
+
+        return _modern(*args, **kwargs)
+
+
+import sys as _sys  # noqa: E402
+
+_sys.modules[__name__].__class__ = _CallableLegacyModule

@@ -59,6 +59,24 @@ def _template_key(base_design, n_iter, with_aero):
     return (_design_hash(base_design), int(n_iter), bool(with_aero))
 
 
+def _design_case_mesh(devices, n_cases):
+    """Factor ``devices`` into the production (design, case) mesh.
+
+    The case extent is gcd(n_devices, n_cases) so the sea-state batch
+    always divides evenly over the 'case' axis (no padding); remaining
+    devices shard the design axis — the big axis in a DOE sweep.
+    """
+    import math
+
+    from jax.sharding import Mesh
+
+    n_dev = len(devices)
+    n_case_ax = math.gcd(n_dev, n_cases)
+    n_design_ax = n_dev // n_case_ax
+    return Mesh(np.asarray(devices).reshape(n_design_ax, n_case_ax),
+                ("design", "case"))
+
+
 def _compile_variant(base_design, axes, combo, device):
     """Per-variant model path (fallback): build the full Model and
     extract solver params eagerly."""
@@ -135,7 +153,7 @@ def _sweep_signature(base_design, axes, combos, sea_states, n_iter, wind):
 
 
 def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
-          checkpoint=None, chunk_size=256, wind=None):
+          checkpoint=None, chunk_size=256, wind=None, devices=None):
     """Run a factorial design sweep.
 
     Parameters
@@ -146,12 +164,25 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         Design-variable axes; full factorial product is evaluated.
     sea_states : list of (Hs, Tp) or (Hs, Tp, heading_deg)
         Wave cases solved (batched) for every design variant.
+    devices : sequence of jax devices, optional
+        Pod-scale execution: the chunk's stacked design leaves are
+        sharded over the 'design' axis and the sea-state batch over the
+        'case' axis of a 2-D device mesh (the north-star sharding:
+        "parametersweep shards design variants over the pod",
+        BASELINE.json; reference loop raft/parametersweep.py:56-100).
+        One entry (or ``None``) keeps the single-device path — then
+        ``device`` selects the chip.
     wind : list of case dicts, optional
         One reference-style case dict per sea state (wind_speed,
         turbulence, ...).  Turns the aero-servo impedance ON: the rotor
         contributions are computed once on the base design (the rotor is
         unchanged by platform-geometry axes) and folded into each case's
-        solve (raft_model.py:905-914).
+        solve (raft_model.py:905-914).  Scope note: the responses are
+        WAVE-excitation-only with the aero-servo impedance (A_aero,
+        B_aero + gyro) folded in at ptfm_pitch=0 — the wind-excitation
+        forcing spectrum (f_aero) is not added to motion_std/AxRNA_std.
+        Use the full ``Model.analyzeCases`` path for combined wind+wave
+        response spectra.
     checkpoint : str, optional
         Path to an .npz progress file.  Designs execute in chunks of
         ``chunk_size``; after each chunk the partial results are saved
@@ -184,6 +215,16 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
     n_cases = len(sea_states)
     if wind is not None and len(wind) != n_cases:
         raise ValueError("wind must align with sea_states (one case dict each)")
+
+    mesh = None
+    if devices is not None:
+        devices = list(devices)
+        if len(devices) == 1:
+            device, devices = devices[0], None
+        else:
+            mesh = _design_case_mesh(devices, n_cases)
+            n_design_ax = mesh.devices.shape[0]
+            mesh_sig = (mesh.devices.shape, tuple(str(d) for d in devices))
 
     results = np.full((n_designs, n_cases, 6), np.nan)
     nacelle_acc = np.full((n_designs, n_cases), np.nan)
@@ -228,6 +269,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
 
     # ----- batched path: stacked geometry through one traced compiler -----
     stacked = None
+    aero_axes = []
     try:
         if memo is not None:
             compile_one, static = memo["compile_one"], memo["static"]
@@ -237,7 +279,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             [jax.tree_util.tree_map(np.asarray, cm.geom) for cm in fowt.memberList],
             jax.tree_util.tree_map(np.asarray, fowt.ms.params) if fowt.ms is not None else None,
         )
-        stacked, treedef = stack_variants(
+        stacked, treedef, aero_axes = stack_variants(
             base_design, axes, combos, rho=fowt.rho_water, g=fowt.g,
             x_ref=fowt.x_ref, y_ref=fowt.y_ref,
             heading_adjust=fowt.heading_adjust,
@@ -246,18 +288,67 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
     except SweepAxisError as e:
         if wind is not None:
             # the fallback exists for axes the batched compiler cannot
-            # express (turbine/site/settings/topology) — exactly the axes
+            # express (site/settings/topology changes) — exactly the axes
             # that would invalidate aero computed once on the base design
             raise ValueError(
                 "wind-enabled sweeps need the batched design path; this "
-                f"axis set falls outside it ({e}). Sweep turbine/site axes "
+                f"axis set falls outside it ({e}). Sweep site/topology axes "
                 "without `wind`, or via the full Model per point.") from e
         if display:
             print(f"sweep: falling back to per-variant model path ({e})")
 
+    # turbine (aero) axes: stack per-variant aero impedance + RNA mass
+    # properties over the DISTINCT turbine-value combinations — the
+    # factorization the OMDAO DOE surface needs (omdao_raft.py:480-696
+    # varies control gains / rotor properties per point)
+    sel_variants = None
+    aero_idx = None
+    if stacked is not None and aero_axes:
+        from .parallel.design_batch import _vkey, rna_params_for
+
+        av_map: dict = {}
+        av_combos = []
+        aero_idx = np.zeros(n_designs, dtype=np.int32)
+        for ic, c in enumerate(combos):
+            key = tuple(_vkey(c[ia]) for ia in aero_axes)
+            if key not in av_map:
+                av_map[key] = len(av_combos)
+                av_combos.append(c)
+            aero_idx[ic] = av_map[key]
+        if display:
+            print(f"sweep: {len(av_combos)} turbine variants along aero axes "
+                  f"{[str(axes[ia][0]) for ia in aero_axes]}")
+        rna_l, zh_l, A_l, B_l = [], [], [], []
+        for c in av_combos:
+            d = copy.deepcopy(base_design)
+            for ia in aero_axes:
+                set_in_design(d, axes[ia][0], c[ia])
+            fv = Model(d).fowtList[0]
+            fv.r6 = np.array([fv.x_ref, fv.y_ref, 0, 0, 0, 0], dtype=float)
+            for rot in fv.rotorList:
+                rot.setPosition(r6=fv.r6)
+            rna_l.append(jax.tree_util.tree_map(np.asarray, rna_params_for(fv)))
+            zh_l.append(np.asarray([float(r.r3[2]) for r in fv.rotorList] or [0.0]))
+            if wind is not None:
+                av = case_aero_params(fv, wind)
+                A_l.append(np.asarray(av["A"]))
+                B_l.append(np.asarray(av["B"]))
+        sel_variants = {
+            "rna": jax.tree_util.tree_map(
+                lambda *xs: jnp.asarray(np.stack(xs)), *rna_l),
+            "zh": jnp.asarray(np.stack(zh_l)),
+        }
+        if wind is not None:
+            sel_variants["A"] = jnp.asarray(np.stack(A_l))
+            sel_variants["B"] = jnp.asarray(np.stack(B_l))
+            aero = None  # per-variant aero replaces the shared-case aero
+
     if stacked is not None:
+        # the jitted chunk executable is specialized to the device mesh
+        # (out_shardings), so the memo keys executables by mesh signature
+        jit_key = (None if mesh is None else mesh_sig)
         if memo is not None and memo["treedef"] == treedef:
-            jitted = memo["jitted"]
+            jitted = memo["jitted"].get(jit_key)
         else:
             jitted = None
         solve_p = make_parametric_solver(static, n_iter=n_iter) if jitted is None else None
@@ -294,14 +385,36 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 return _metrics(Xi), pr
 
         if jitted is None:
-            jitted = jax.jit(chunk_fn)
-            _TEMPLATE_MEMO[memo_key] = {
+            if mesh is None:
+                jitted = jax.jit(chunk_fn)
+            else:
+                from jax.sharding import NamedSharding, PartitionSpec as P
+
+                dc = NamedSharding(mesh, P("design", "case"))
+                d_only = NamedSharding(mesh, P("design"))
+                out_sh = ((dc, dc),
+                          {k: d_only for k in ("mass", "displacement", "GMT")})
+                jitted = jax.jit(chunk_fn, out_shardings=out_sh)
+            entry = _TEMPLATE_MEMO.setdefault(memo_key, {
                 "model": model, "fowt": fowt, "compile_one": compile_one,
-                "static": static, "treedef": treedef, "jitted": jitted,
-            }
+                "static": static, "treedef": treedef, "jitted": {},
+            })
+            entry["jitted"][jit_key] = jitted
             while len(_TEMPLATE_MEMO) > _TEMPLATE_MEMO_MAX:
                 _TEMPLATE_MEMO.pop(next(iter(_TEMPLATE_MEMO)))
         chunk_size = min(chunk_size, n_designs)
+        if mesh is not None:
+            # every chunk must tile the 'design' mesh axis exactly
+            chunk_size = max(n_design_ax,
+                             (chunk_size // n_design_ax) * n_design_ax)
+            from jax.sharding import NamedSharding, PartitionSpec as P
+
+            d_shard = NamedSharding(mesh, P("design"))
+            c_shard = NamedSharding(mesh, P("case"))
+            zetas = jax.device_put(zetas, c_shard)
+            betas = jax.device_put(betas, c_shard)
+            if aero is not None:
+                aero = jax.device_put(aero, c_shard)
 
         for start in range(0, n_designs, chunk_size):
             stop = min(start + chunk_size, n_designs)
@@ -313,9 +426,12 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             n_real = stop - start
             idx = np.arange(start, start + chunk_size)
             idx[n_real:] = stop - 1
-            leaves = [jnp.asarray(lf[idx]) for lf in stacked]
-            if device is not None:
-                leaves = [jax.device_put(lf, device) for lf in leaves]
+            if mesh is not None:
+                leaves = [jax.device_put(lf[idx], d_shard) for lf in stacked]
+            else:
+                leaves = [jnp.asarray(lf[idx]) for lf in stacked]
+                if device is not None:
+                    leaves = [jax.device_put(lf, device) for lf in leaves]
             if aero is None:
                 (std, a_std), pr = jitted(leaves, zetas, betas)
             else:

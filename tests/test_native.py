@@ -79,3 +79,12 @@ def test_pv_fd_matches_numpy():
         ref = gfd._pv_fd_numpy(R, s, K, h, k, kind)
         np.testing.assert_allclose(nat, ref, atol=1e-10)
 
+    # adversarial pairing: a near-surface small-R point chunked with a
+    # large-R point — the per-point tail truncation must hold (a
+    # chunk-wide max-T grid differs here by ~2e-5)
+    R_adv = np.array([0.05, 5.0])
+    s_adv = np.array([-0.01, -0.01])
+    nat = native.pv_fd_points(R_adv, s_adv, K, h, k, 1)
+    ref = gfd._pv_fd_numpy(R_adv, s_adv, K, h, k, 1)
+    np.testing.assert_allclose(nat, ref, atol=1e-10)
+
