@@ -279,7 +279,8 @@ def _line_forces_at_points(ms: CompiledMooring, params: MooringParams, pos):
     # steep contact chord in strong current can drive w - q_z through
     # zero, and the catenary solver divides by w (LB = L - VF/w)
     w_line = jnp.where(contact,
-                       jnp.maximum(params.w - q[:, 2], 1e-3 * params.w),
+                       jnp.maximum(params.w - q[:, 2],
+                                   1e-3 * jnp.abs(params.w) + 1e-6),
                        w_eff)
 
     # lo->hi frame (by effective-vertical separation) for the 2-D solver

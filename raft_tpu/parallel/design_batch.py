@@ -219,7 +219,12 @@ def stack_variants(base_design, axes, combos, rho, g, x_ref=0.0, y_ref=0.0,
     import zlib
 
     spot = {n_designs // 2, n_designs - 1}
-    seed = zlib.crc32(np.ascontiguousarray(idx, dtype=np.int64).tobytes())
+    seed = 0
+    for _, values in axes:
+        for v in values:
+            vk = _vkey(v)
+            seed = zlib.crc32(repr(vk).encode()
+                              if not isinstance(vk, tuple) else vk[2], seed)
     rng = np.random.default_rng(seed)
     spot.update(int(i) for i in rng.choice(n_designs, size=min(4, n_designs),
                                            replace=False))

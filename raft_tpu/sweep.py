@@ -345,44 +345,71 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
 
     if stacked is not None:
         # the jitted chunk executable is specialized to the device mesh
-        # (out_shardings), so the memo keys executables by mesh signature
-        jit_key = (None if mesh is None else mesh_sig)
+        # (out_shardings) and to the turbine-variant mode, so the memo
+        # keys executables by (mode, mesh signature)
+        mode = ("sel_wind" if sel_variants is not None and wind is not None
+                else "sel" if sel_variants is not None
+                else "aero" if aero is not None else "plain")
+        jit_key = (mode, None if mesh is None else mesh_sig)
         if memo is not None and memo["treedef"] == treedef:
             jitted = memo["jitted"].get(jit_key)
         else:
             jitted = None
         solve_p = make_parametric_solver(static, n_iter=n_iter) if jitted is None else None
         # nacelle positions for the acceleration channel (constant across
-        # platform-geometry variants, like the rotors themselves); the
+        # platform-geometry variants; per-variant along turbine axes); the
         # reported channel is the max over rotors, matching what the WEIS
         # Max_Nacelle_Acc aggregate reads (omdao: stat max over rotors)
         z_hubs = jnp.asarray([float(r.r3[2]) for r in fowt.rotorList] or [0.0])
         w_j = jnp.asarray(fowt.w)
 
-        def _metrics(Xi):
+        def _metrics(Xi, zh):
+            """Xi [chunk, ncase, 1, 6, nw]; zh [chunk, nrot]."""
             std = jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1))
             # nacelle fore-aft acceleration amplitude: -w^2 (xi1 + z_hub*xi5)
             a_nac = (w_j**2) * (Xi[:, :, 0, 0, None, :]
-                                + z_hubs[None, None, :, None] * Xi[:, :, 0, 4, None, :])
+                                + zh[:, None, :, None] * Xi[:, :, 0, 4, None, :])
             a_std = jnp.sqrt(0.5 * jnp.sum(jnp.abs(a_nac) ** 2, axis=-1))
             return std, jnp.max(a_std, axis=-1)
 
-        if aero is None:
+        if mode == "plain":
             def chunk_fn(leaves, zetas, betas):
                 geoms, moor = jax.tree_util.tree_unflatten(treedef, leaves)
                 params = jax.vmap(compile_one)(geoms, moor)
                 pr = params.pop("props")
                 Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
                               in_axes=(0, None, None))(params, zetas, betas)
-                return _metrics(Xi), pr
-        else:
+                zh = jnp.broadcast_to(z_hubs, (Xi.shape[0],) + z_hubs.shape)
+                return _metrics(Xi, zh), pr
+        elif mode == "aero":
             def chunk_fn(leaves, zetas, betas, aero):
                 geoms, moor = jax.tree_util.tree_unflatten(treedef, leaves)
                 params = jax.vmap(compile_one)(geoms, moor)
                 pr = params.pop("props")
                 Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0, 0)),
                               in_axes=(0, None, None, None))(params, zetas, betas, aero)
-                return _metrics(Xi), pr
+                zh = jnp.broadcast_to(z_hubs, (Xi.shape[0],) + z_hubs.shape)
+                return _metrics(Xi, zh), pr
+        else:
+            # turbine (aero) axes: gather each design's turbine variant —
+            # RNA mass properties into the statics rollup, per-variant
+            # aero-servo impedance into the case solve, per-variant hub
+            # heights into the nacelle channel (the factorized
+            # (geometry batch x turbine variant) decomposition the OMDAO
+            # DOE surface needs, omdao_raft.py:480-696)
+            def chunk_fn(leaves, zetas, betas, sel, av):
+                geoms, moor = jax.tree_util.tree_unflatten(treedef, leaves)
+                rna = jax.tree_util.tree_map(lambda x: x[av], sel["rna"])
+                params = jax.vmap(compile_one)(geoms, moor, rna)
+                pr = params.pop("props")
+                if "A" in sel:
+                    aero_v = {"A": sel["A"][av], "B": sel["B"][av]}
+                    Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0, 0)),
+                                  in_axes=(0, None, None, 0))(params, zetas, betas, aero_v)
+                else:
+                    Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
+                                  in_axes=(0, None, None))(params, zetas, betas)
+                return _metrics(Xi, sel["zh"][av]), pr
 
         if jitted is None:
             if mesh is None:
@@ -415,6 +442,12 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             betas = jax.device_put(betas, c_shard)
             if aero is not None:
                 aero = jax.device_put(aero, c_shard)
+            if sel_variants is not None:
+                # small per-turbine-variant tables: replicate; the per-chunk
+                # gather index is design-sharded, so the gathered arrays
+                # land design-sharded without collectives
+                sel_variants = jax.device_put(
+                    sel_variants, NamedSharding(mesh, P()))
 
         for start in range(0, n_designs, chunk_size):
             stop = min(start + chunk_size, n_designs)
@@ -432,10 +465,17 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 leaves = [jnp.asarray(lf[idx]) for lf in stacked]
                 if device is not None:
                     leaves = [jax.device_put(lf, device) for lf in leaves]
-            if aero is None:
+            if mode == "plain":
                 (std, a_std), pr = jitted(leaves, zetas, betas)
-            else:
+            elif mode == "aero":
                 (std, a_std), pr = jitted(leaves, zetas, betas, aero)
+            else:
+                av = jnp.asarray(aero_idx[idx])
+                if mesh is not None:
+                    av = jax.device_put(av, d_shard)
+                elif device is not None:
+                    av = jax.device_put(av, device)
+                (std, a_std), pr = jitted(leaves, zetas, betas, sel_variants, av)
             results[start:stop] = np.asarray(std)[:n_real]
             nacelle_acc[start:stop] = np.asarray(a_std)[:n_real]
             for k in props:

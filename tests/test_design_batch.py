@@ -66,7 +66,7 @@ def test_batch_compiler_params_match_design_params():
 
     compile_one, static = make_batch_compiler(fowt)
     assert static == s_ref
-    stacked, treedef = stack_variants(design, [], [()], rho=fowt.rho_water, g=fowt.g)
+    stacked, treedef, _ = stack_variants(design, [], [()], rho=fowt.rho_water, g=fowt.g)
     leaves = [jnp.asarray(lf[0]) for lf in stacked]
     geoms, moor = jax.tree_util.tree_unflatten(treedef, leaves)
     p_new = compile_one(geoms, moor)
@@ -178,6 +178,80 @@ def test_sweep_nacelle_acceleration_channel():
     assert np.all(np.isfinite(a)) and np.all(a > 0)
     # rougher sea state -> larger nacelle acceleration for every design
     assert np.all(a[:, 1] > a[:, 0])
+
+
+def test_turbine_axis_batched():
+    """A turbine-dict axis (RNA mass) rides the batched path as a
+    per-variant RNA/aero gather (the OMDAO DOE surface varies turbine
+    parameters, omdao_raft.py:480-696): the factorial sweep must equal
+    independent sweeps with each turbine value baked into the base
+    design, and the results must actually vary along the turbine axis."""
+    import copy
+
+    from raft_tpu import sweep as sweep_mod
+
+    base = _demo()
+    m0 = base["turbine"]["mRNA"]
+    turb_vals = [0.7 * m0, 1.3 * m0]
+    geom_axis = ("platform.members.0.d",
+                 [[9.4, 9.4, 6.5, 6.5], [10.0, 10.0, 6.5, 6.5]])
+    out = sweep_mod.sweep(base, [("turbine.mRNA", turb_vals), geom_axis],
+                          STATES, n_iter=6)
+    assert out["motion_std"].shape == (4, 2, 6)
+    assert np.all(np.isfinite(out["motion_std"]))
+    # heavier RNA shifts the response: the turbine axis is live
+    assert not np.allclose(out["motion_std"][:2], out["motion_std"][2:])
+
+    for iv, v in enumerate(turb_vals):
+        d = copy.deepcopy(base)
+        d["turbine"]["mRNA"] = v
+        ref = sweep_mod.sweep(d, [geom_axis], STATES, n_iter=6)
+        rows = slice(2 * iv, 2 * iv + 2)
+        np.testing.assert_allclose(out["motion_std"][rows],
+                                   ref["motion_std"], rtol=1e-9, atol=1e-14)
+        np.testing.assert_allclose(out["AxRNA_std"][rows],
+                                   ref["AxRNA_std"], rtol=1e-9, atol=1e-14)
+        np.testing.assert_allclose(out["mass"][rows], ref["mass"], rtol=1e-9)
+
+
+def test_turbine_axis_batched_with_wind():
+    """Turbine axis + wind: per-variant aero-servo impedance (A/B) and
+    hub height must be substituted per design, matching sweeps with the
+    turbine value baked in (reference behavior: calcTurbineConstants
+    re-runs per design point, raft_model.py:545)."""
+    import copy
+
+    import yaml
+
+    from raft_tpu import sweep as sweep_mod
+
+    with open("/root/reference/tests/test_data/VolturnUS-S.yaml") as f:
+        base = yaml.load(f, Loader=yaml.FullLoader)
+    base.setdefault("settings", {})
+    base["settings"]["min_freq"] = 0.05
+    base["settings"]["max_freq"] = 0.4
+
+    h0 = float(base["turbine"]["hHub"])
+    turb_vals = [h0, h0 + 15.0]
+    geom_axis = ("platform.members.0.d", [10.0, 10.8])
+    wind = [{"wind_speed": 8.0}, {"wind_speed": 12.0}]
+    states = [(4.0, 8.0), (6.0, 10.0)]
+
+    out = sweep_mod.sweep(base, [("turbine.hHub", turb_vals), geom_axis],
+                          states, n_iter=6, wind=wind)
+    assert np.all(np.isfinite(out["motion_std"]))
+    # a taller tower-top changes the aero impedance arm + nacelle channel
+    assert not np.allclose(out["AxRNA_std"][:2], out["AxRNA_std"][2:])
+
+    for iv, v in enumerate(turb_vals):
+        d = copy.deepcopy(base)
+        d["turbine"]["hHub"] = v
+        ref = sweep_mod.sweep(d, [geom_axis], states, n_iter=6, wind=wind)
+        rows = slice(2 * iv, 2 * iv + 2)
+        np.testing.assert_allclose(out["motion_std"][rows],
+                                   ref["motion_std"], rtol=1e-9, atol=1e-14)
+        np.testing.assert_allclose(out["AxRNA_std"][rows],
+                                   ref["AxRNA_std"], rtol=1e-9, atol=1e-14)
 
 
 def test_sweep_template_memoization():
