@@ -198,16 +198,35 @@ def make_parametric_solver(static, n_iter=15):
                 + C_const[None, :, :]
             )
 
+        # ---- drag-linearization operators, hoisted out of the scan ----
+        # The Borgman iteration needs only the q/p1/p2-projected relative
+        # node velocities, which are LINEAR in the motion amplitudes:
+        #     v_node = 1j w (Xi_t + Xi_r x offs)
+        #     q . v_node = 1j w ([q, offs x q] . Xi)
+        # so each iteration reduces to three [N,6]x[6,nw] matmuls (MXU
+        # work) instead of a materialized [N,3,nw] complex velocity field
+        # — whose 3-extent sublane also padded 8x on TPU.  Likewise the
+        # drag excitation sum_n [B u; offs x (B u)] is one [6,3N]x[3N,nw]
+        # contraction via the stacked translation operator TB.
+        Pq = jnp.concatenate([q_n, jnp.cross(offs, q_n)], axis=1)  # [N,6]
+        Pp1 = jnp.concatenate([p1_n, jnp.cross(offs, p1_n)], axis=1)
+        Pp2 = jnp.concatenate([p2_n, jnp.cross(offs, p2_n)], axis=1)
+        u0 = u[0]
+        uq0 = jnp.einsum("niw,ni->nw", u0, q_n)
+        up10 = jnp.einsum("niw,ni->nw", u0, p1_n)
+        up20 = jnp.einsum("niw,ni->nw", u0, p2_n)
+        jw = (1j * w)[None, :]
+        # [N,3,3]: skew @ F = offs x F (alternator gives cross(v, r))
+        skew = -transforms.alternator(offs)
+
+        def rms_rows(x2):  # sqrt(0.5 sum |.|^2) over the last axis
+            return jnp.sqrt(0.5 * jnp.sum(jnp.abs(x2) ** 2, axis=-1))
+
         def drag_terms(Xi):
             """Borgman linearization on the flat node set (heading 0)."""
-            _, vnode, _ = waves_ops.kinematics_from_modes(offs, Xi, w)  # [N,3,nw]
-            vrel = u[0] - vnode
-            vq = jnp.einsum("niw,ni->nw", vrel, q_n)
-            vp1 = jnp.einsum("niw,ni->nw", vrel, p1_n)
-            vp2 = jnp.einsum("niw,ni->nw", vrel, p2_n)
-
-            def rms_rows(x2):  # sum |.|^2 over last axis
-                return jnp.sqrt(0.5 * jnp.sum(jnp.abs(x2) ** 2, axis=-1))
+            vq = uq0 - jw * (Pq @ Xi)
+            vp1 = up10 - jw * (Pp1 @ Xi)
+            vp2 = up20 - jw * (Pp2 @ Xi)
 
             vRMS_q = rms_rows(vq)
             vRMS_perp = jnp.sqrt(rms_rows(vp1) ** 2 + rms_rows(vp2) ** 2)
@@ -225,17 +244,13 @@ def make_parametric_solver(static, n_iter=15):
             B6 = jnp.sum(transforms.translate_matrix_3to6(Bmat, offs), axis=0)
             return B6, Bmat
 
-        def drag_excitation(Bmat, ih):
-            F3d = jnp.einsum("nij,njw->nwi", Bmat, u[ih])
-            F6d = transforms.translate_force_3to6(F3d, offs[:, None, :])
-            return jnp.transpose(jnp.sum(F6d, axis=0), (1, 0))  # [6,nw]
-
         # fixed-point drag linearization on the primary heading
         # (raft_model.py:918-991; fixed iteration count batches cleanly,
         # under-relaxation 0.2/0.8 matches the reference)
         def body(Xi_last, _):
             B6, Bmat = drag_terms(Xi_last)
-            F0 = Fexc[0] + drag_excitation(Bmat, 0)
+            TB = jnp.concatenate([Bmat, skew @ Bmat], axis=1)  # [N,6,3]
+            F0 = Fexc[0] + jnp.einsum("nsj,njw->sw", TB, u0)
             Z = impedance(B6)
             # batch-last fused Gauss-Jordan: the framework's hottest op
             # (Pallas kernel on TPU, ~40x over jnp.linalg.solve there)
@@ -248,7 +263,8 @@ def make_parametric_solver(static, n_iter=15):
         # final linearized system + response for every heading
         B6, Bmat = drag_terms(Xi_relaxed)
         Z = impedance(B6)
-        F_all = Fexc + jax.vmap(lambda ih: drag_excitation(Bmat, ih))(jnp.arange(nH))
+        TB = jnp.concatenate([Bmat, skew @ Bmat], axis=1)
+        F_all = Fexc + jnp.einsum("nsj,hnjw->hsw", TB, u)
         return smallsolve.solve_impedance_multi(Z, F_all)
 
     return solve
