@@ -26,18 +26,33 @@ def enable_compilation_cache(path: str | None = None) -> str:
 
     Default location: ``$RAFT_TPU_CACHE_DIR``, else ``.jax_cache/`` next
     to this package (repo-local so it survives across driver rounds).
+
+    Scope: accelerator backends only.  XLA:CPU persists AOT executables
+    that embed the compile host's CPU feature list — including tuning
+    pseudo-features (``+prefer-no-scatter``...) the host-side detector
+    never reports — so re-loading them spams ``cpu_aot_loader`` errors
+    warning of SIGILL and falls back to recompiling anyway, even on the
+    machine that wrote them.  On the CPU backend the cache is therefore
+    all cost and no benefit; this is a no-op there (returns None).
     """
     import os
 
+    if jax.default_backend() == "cpu":
+        return None
     if path is None:
         path = os.environ.get("RAFT_TPU_CACHE_DIR")
     if path is None:
         path = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache")
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
-    # cache everything: the sweep path is many medium-sized programs, and
-    # the default 1 s / 2 MiB floors would skip most of them
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 0)
+    # floor at 6 s of compile time: that admits the two big sweep-chunk
+    # executables (partA ~15 s, partB ~7 s on TPU, the only entries worth
+    # persisting since the round-5 split-AOT design) while keeping the
+    # mixed CPU-backend helper programs of the same process out of the
+    # cache (largest: _eval_and_jac ~4 s) — a CPU AOT entry would only
+    # spam the loader on the next run (see above) since its
+    # machine-feature check rejects it even same-host
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 6)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     return path
 

@@ -139,6 +139,12 @@ def read_hydro(fowt):
     fExImagInterp = _interp_axis2(np.hstack([w3, 0.0]),
                                   np.dstack([I, np.zeros([len(heads), 6, 1])]), fowt.w)
 
+    # NOTE on normalization: true WAMIT .1 files store Bbar =
+    # B/(rho L^k omega), so the dimensional damping is rho*omega*Bbar.
+    # The reference applies rho only (raft_fowt.py:742-743), and this
+    # path mirrors that for output parity on reference configs; the
+    # native solver's truth test (tests/test_bem_oc4.py) uses the
+    # physical rho*omega*Bbar convention.
     fowt.A_BEM = fowt.rho_water * addedMassInterp
     fowt.B_BEM = fowt.rho_water * dampingInterp
     X_temp = fowt.rho_water * fowt.g * (fExRealInterp + 1j * fExImagInterp)

