@@ -89,6 +89,23 @@ def compile_member_list(design, heading_adjust=0.0, dls_max_default=None):
 # ---------------------------------------------------------------------------
 
 
+def prepare_turbine_dict(turbine: dict, site: dict) -> int:
+    """Normalize a design's turbine dict in place for Rotor construction:
+    coerce ``nrotors`` and copy the site fluid properties in
+    (raft_fowt.py:85-90).  Shared by the FOWT constructor and the
+    sweep's light turbine-variant builder so the preprocessing cannot
+    diverge.  Returns nrotors."""
+    nrotors = int(get_from_dict(turbine, "nrotors", dtype=int, shape=0, default=1))
+    turbine["nrotors"] = nrotors
+    turbine["rho_air"] = float(get_from_dict(site, "rho_air", shape=0, default=1.225))
+    turbine["mu_air"] = float(get_from_dict(site, "mu_air", shape=0, default=1.81e-05))
+    turbine["shearExp_air"] = float(get_from_dict(site, "shearExp_air", shape=0, default=0.12))
+    turbine["rho_water"] = float(get_from_dict(site, "rho_water", shape=0, default=1025.0))
+    turbine["mu_water"] = float(get_from_dict(site, "mu_water", shape=0, default=1.0e-03))
+    turbine["shearExp_water"] = float(get_from_dict(site, "shearExp_water", shape=0, default=0.12))
+    return nrotors
+
+
 def _member_wave_kinematics(pose, zeta, beta, w, k, depth, rho, g):
     """Wave kinematics spectra at every node for every heading.
 
@@ -285,15 +302,7 @@ class FOWT:
         self.nrotors = 0
         turbine = design.get("turbine", None)
         if turbine is not None:
-            self.nrotors = int(get_from_dict(turbine, "nrotors", dtype=int, shape=0, default=1))
-            turbine["nrotors"] = self.nrotors
-            # copy site fluid properties into the turbine dict (raft_fowt.py:85-90)
-            turbine["rho_air"] = float(get_from_dict(site, "rho_air", shape=0, default=1.225))
-            turbine["mu_air"] = float(get_from_dict(site, "mu_air", shape=0, default=1.81e-05))
-            turbine["shearExp_air"] = float(get_from_dict(site, "shearExp_air", shape=0, default=0.12))
-            turbine["rho_water"] = float(get_from_dict(site, "rho_water", shape=0, default=1025.0))
-            turbine["mu_water"] = float(get_from_dict(site, "mu_water", shape=0, default=1.0e-03))
-            turbine["shearExp_water"] = float(get_from_dict(site, "shearExp_water", shape=0, default=0.12))
+            self.nrotors = prepare_turbine_dict(turbine, site)
 
         # ----- rotors -----
         self.rotorList: list[Rotor] = []

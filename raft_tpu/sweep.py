@@ -142,25 +142,16 @@ def _turbine_variant_fowt(fowt, base_design, axes, aero_axes, combo):
     ``calcTurbineConstants`` then writes its A_aero/B_aero onto the
     copy without touching the template.
     """
+    from .core.fowt import prepare_turbine_dict
     from .rotor.rotor import Rotor
-    from .schema import get_from_dict
 
     d = copy.deepcopy(base_design)
     for ia in aero_axes:
         set_in_design(d, axes[ia][0], combo[ia])
     turbine = d["turbine"]
-    site = d.get("site", {})
-    turbine["nrotors"] = int(get_from_dict(turbine, "nrotors", dtype=int,
-                                           shape=0, default=1))
-    turbine["rho_air"] = float(get_from_dict(site, "rho_air", shape=0, default=1.225))
-    turbine["mu_air"] = float(get_from_dict(site, "mu_air", shape=0, default=1.81e-05))
-    turbine["shearExp_air"] = float(get_from_dict(site, "shearExp_air", shape=0, default=0.12))
-    turbine["rho_water"] = float(get_from_dict(site, "rho_water", shape=0, default=1025.0))
-    turbine["mu_water"] = float(get_from_dict(site, "mu_water", shape=0, default=1.0e-03))
-    turbine["shearExp_water"] = float(get_from_dict(site, "shearExp_water", shape=0, default=0.12))
 
     fv = copy.copy(fowt)
-    fv.nrotors = turbine["nrotors"]
+    fv.nrotors = prepare_turbine_dict(turbine, d.get("site", {}))
     fv.rotorList = [Rotor(turbine, fowt.w, ir) for ir in range(fv.nrotors)]
     fv.r6 = np.array([fv.x_ref, fv.y_ref, 0, 0, 0, 0], dtype=float)
     for rot in fv.rotorList:
@@ -372,18 +363,24 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         # measurement) re-derives an identical [n_designs, ...] batch —
         # ~1.4 s of host deepcopy/parse per call for the 1000-design grid.
         # (Axis paths + exact value bytes identify the batch; the design
-        # itself is already pinned by memo_key.)
-        import hashlib
+        # itself is already pinned by memo_key.)  CALLABLE axis paths
+        # carry only address identity — a recycled address would alias a
+        # different mutation — so such sweeps never use the stack memo
+        # (same conservative stance as the checkpoint signature).
+        stack_key = None
+        if not any(callable(p) for p, _ in axes):
+            import hashlib
 
-        h = hashlib.sha256(repr([str(p) for p, _ in axes]).encode())
-        for combo in combos:
-            for v in combo:
-                # full value identity (shape + dtype + bytes for arrays,
-                # repr otherwise) — byte-identical values of different
-                # shape/dtype must not collide into a stale batch
-                h.update(repr(_vkey(v)).encode())
-        stack_key = h.hexdigest()
-        cached_stack = (memo or {}).get("stacks", {}).get(stack_key)
+            h = hashlib.sha256(repr([str(p) for p, _ in axes]).encode())
+            for combo in combos:
+                for v in combo:
+                    # full value identity (shape + dtype + bytes for
+                    # arrays, repr otherwise) — byte-identical values of
+                    # different shape/dtype must not collide
+                    h.update(repr(_vkey(v)).encode())
+            stack_key = h.hexdigest()
+        cached_stack = (memo or {}).get("stacks", {}).get(stack_key) \
+            if stack_key is not None else None
         if cached_stack is not None:
             stacked, treedef, aero_axes = cached_stack
         else:
@@ -444,10 +441,13 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                              (chunk_size // n_design_ax) * n_design_ax)
         # the chunk executables are AOT-compiled against exact argument
         # shapes and shardings, so the memo keys them by everything that
-        # shapes the programs: mode, mesh, chunk/case/variant extents —
+        # shapes the programs: mode, the device/mesh placement (a Compiled
+        # object is pinned to it — unlike jit it cannot transparently
+        # recompile for a different device), chunk/case/variant extents —
         # and checks treedef+spec (the packed transfer layout)
-        jit_key = (mode, None if mesh is None else mesh_sig,
-                   chunk_size, n_cases, len(av_combos))
+        place_sig = (mesh_sig if mesh is not None
+                     else str(device) if device is not None else None)
+        jit_key = (mode, place_sig, chunk_size, n_cases, len(av_combos))
         if (memo is not None and memo["treedef"] == treedef
                 and memo.get("spec") == spec):
             jitted = memo["jitted"].get(jit_key)
@@ -583,17 +583,42 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 argsA = (packed_sds,)
 
             # trace serially on this thread (tracing is Python and holds
-            # the GIL anyway); compile concurrently on worker threads
+            # the GIL anyway); compile concurrently on worker threads.
+            # Each thread also runs its executable ONCE on zero-filled
+            # arguments: the first invocation pays a few seconds of
+            # executable upload/initialization on a remote-chip runtime,
+            # and absorbing it here overlaps it with the main thread's
+            # aero-table work (the garbage outputs are discarded — a
+            # zero-geometry solve just produces NaNs in dead buffers).
             lA = jA.lower(*argsA)
             built: dict = {}
 
-            def _compile(key, lowered):
+            def _compile(key, lowered, dummy_args_fn):
                 try:
-                    built[key] = lowered.compile()
+                    compiled = lowered.compile()
+                    built[key] = compiled
+                    try:
+                        jax.block_until_ready(compiled(*dummy_args_fn()))
+                    except Exception:
+                        pass  # warm-exec is best-effort
                 except Exception as e:  # pragma: no cover - best-effort
                     built[key] = e
 
-            tA = threading.Thread(target=_compile, args=("A", lA), daemon=True)
+            def _zeros_like_sds(tree, put):
+                return jax.tree_util.tree_map(
+                    lambda s: put(np.zeros(s.shape, s.dtype)), tree)
+
+            if mode in ("sel", "sel_wind"):
+                def dummyA():
+                    return (_zeros_like_sds(packed_sds, put_d),
+                            _zeros_like_sds(rna_sds, put_r),
+                            put_d(np.zeros((chunk_size,), np.int32)))
+            else:
+                def dummyA():
+                    return (_zeros_like_sds(packed_sds, put_d),)
+
+            tA = threading.Thread(target=_compile, args=("A", lA, dummyA),
+                                  daemon=True)
             tA.start()
             threads.append(tA)
 
@@ -612,8 +637,22 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                            for k in ("A", "B")}
                 sel_sds["zh"] = sds((len(av_combos), nrot), fdt)
                 argsB = (params_sds, zetas, betas, sel_sds, av_sds)
+            def dummyB():
+                params_z = _zeros_like_sds(params_sds, put_d)
+                if mode == "plain":
+                    return (params_z, zetas, betas)
+                if mode == "aero":
+                    return (params_z, zetas, betas,
+                            _zeros_like_sds(argsB[3], put_c))
+                # sel / sel_wind: replicated variant table + design-sharded
+                # gather index
+                return (params_z, zetas, betas,
+                        _zeros_like_sds(argsB[3], put_r),
+                        put_d(np.zeros((chunk_size,), np.int32)))
+
             lB = jB.lower(*argsB)
-            tB = threading.Thread(target=_compile, args=("B", lB), daemon=True)
+            tB = threading.Thread(target=_compile, args=("B", lB, dummyB),
+                                  daemon=True)
             tB.start()
             threads.append(tB)
 
@@ -670,7 +709,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             while len(_TEMPLATE_MEMO) > _TEMPLATE_MEMO_MAX:
                 _TEMPLATE_MEMO.pop(next(iter(_TEMPLATE_MEMO)))
         cA, cB = jitted
-        if cached_stack is None:
+        if cached_stack is None and stack_key is not None:
             entry = _TEMPLATE_MEMO.get(memo_key)
             if entry is not None and entry.get("treedef") == treedef:
                 stacks = entry.setdefault("stacks", {})

@@ -102,9 +102,11 @@ def test_analyzeCases_wave_case(models, name):
 @pytest.mark.parametrize("name", ["VolturnUS-S", "OC3spar"])
 def test_analyzeCases_all_cases(name):
     """Every case in the design yaml, including the wind+current case that
-    exercises the JAX BEM aero path.  Measured parity: wave-only cases
-    ~1e-6 rel-to-peak; wind cases 0.2-3% (independent BEM vs the
-    reference's Fortran CCBlade) — asserted with margin."""
+    exercises the JAX BEM aero path.  Measured parity (round 5): wave-only
+    cases ~1.5e-6 rel-to-peak; wind cases 0.2-3.0% (independent BEM vs
+    the reference's Fortran CCBlade; worst channel VolturnUS pitch_PSD
+    2.95e-2) — locked at 4e-2 so regressions and improvements both
+    surface."""
     model = _model(name)
     model.analyzeCases()
     with open(os.path.join(TEST_DATA, f"{name}_true_analyzeCases.pkl"), "rb") as f:
@@ -113,7 +115,7 @@ def test_analyzeCases_all_cases(name):
     for iCase in model.results["case_metrics"]:
         case = dict(zip(model.design["cases"]["keys"], model.design["cases"]["data"][iCase]))
         windy = float(np.atleast_1d(case["wind_speed"])[0]) > 0
-        tol = 6e-2 if windy else 1e-5
+        tol = 4e-2 if windy else 1e-5
         mine = model.results["case_metrics"][iCase][0]
         g = gold[iCase][0]
         for metric in ("surge_PSD", "pitch_PSD", "heave_PSD", "AxRNA_PSD", "Mbase_PSD"):

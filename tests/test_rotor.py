@@ -19,9 +19,16 @@ levels, documented per-channel below, are:
   resample all match CCBlade's published formulation; n_sector and
   element-count refinement move My by <1%, and a Pitt-Peters skewed
   -wake correction at the 6 deg tilt is an order of magnitude too
-  small to explain the gap.  The residual factor therefore lives in
-  the Fortran CCBlade's asymmetry response itself (not reproducible
-  bit-for-bit without its source, which this environment lacks);
+  small to explain the gap.  A further experiment scaled the
+  distributed loads by the combined Prandtl factor F: it zeroed the
+  below-rated T/Q offset and brought My within 5%, but drove the
+  dT/dU adjoint goldens from +3% to -8..-11% and above-rated T to
+  +10% (F shrinks the negative-thrust tip elements there), so it is
+  NOT CCBlade's convention — the evidence localizes the gap to the
+  tip-region load distribution without identifying the mechanism.
+  The residual factor therefore lives in the Fortran CCBlade's
+  asymmetry response itself (not reproducible bit-for-bit without its
+  source, which this environment lacks);
   ``test_cross_axis_response_bands`` locks the measured ratios so any
   regression OR improvement is flagged.
 """
