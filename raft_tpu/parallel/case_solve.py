@@ -183,13 +183,23 @@ def make_parametric_solver(static, n_iter=15):
         pDyn = pDyn * wet[None, :, None]
 
         # ----- Froude-Krylov + added-mass inertial excitation -----
+        # assembled as one [6,3N]x[3N,nw] contraction through the stacked
+        # translation operator TI = [Imat; offs x Imat] instead of
+        # materializing per-node [nH,N,nw,6] force fields (same
+        # MXU-friendly collapse as the drag terms below)
+        skew = -transforms.alternator(offs)  # [N,3,3]: skew @ v = offs x v
+        aq = nodes["a_i"][:, None] * q_n     # [N,3]
+        Pa = jnp.concatenate([aq, jnp.cross(offs, aq)], axis=1)  # [N,6]
         if mcf:
-            F3 = jnp.einsum("nijw,hnjw->hnwi", nodes["imat"], ud)
+            TI = jnp.concatenate(
+                [nodes["imat"],
+                 jnp.einsum("nij,njkw->nikw", skew, nodes["imat"])], axis=1)
+            Fexc = (jnp.einsum("nsjw,hnjw->hsw", TI, ud)
+                    + jnp.einsum("ns,hnw->hsw", Pa, pDyn))
         else:
-            F3 = jnp.einsum("nij,hnjw->hnwi", nodes["imat"], ud)
-        F3 = F3 + pDyn[:, :, :, None] * (nodes["a_i"][None, :, None, None] * q_n[None, :, None, :])
-        F6 = transforms.translate_force_3to6(F3, offs[None, :, None, :])  # [nH,N,nw,6]
-        Fexc = jnp.transpose(jnp.sum(F6, axis=1), (0, 2, 1))  # [nH,6,nw]
+            TI = jnp.concatenate([nodes["imat"], skew @ nodes["imat"]], axis=1)
+            Fexc = (jnp.einsum("nsj,hnjw->hsw", TI, ud)
+                    + jnp.einsum("ns,hnw->hsw", Pa, pDyn))
 
         def impedance(B_drag):
             return (
@@ -215,9 +225,7 @@ def make_parametric_solver(static, n_iter=15):
         uq0 = jnp.einsum("niw,ni->nw", u0, q_n)
         up10 = jnp.einsum("niw,ni->nw", u0, p1_n)
         up20 = jnp.einsum("niw,ni->nw", u0, p2_n)
-        jw = (1j * w)[None, :]
-        # [N,3,3]: skew @ F = offs x F (alternator gives cross(v, r))
-        skew = -transforms.alternator(offs)
+        jw = (1j * w)[None, :]  # (skew defined with the excitation above)
 
         def rms_rows(x2):  # sqrt(0.5 sum |.|^2) over the last axis
             return jnp.sqrt(0.5 * jnp.sum(jnp.abs(x2) ** 2, axis=-1))

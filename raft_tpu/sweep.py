@@ -593,14 +593,21 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             lA = jA.lower(*argsA)
             built: dict = {}
 
+            # warm-exec only pays when the main thread has aero/variant
+            # table work to overlap it with; in 'plain' mode the join
+            # happens immediately, so a dummy run would simply extend the
+            # critical path by one chunk execution
+            warm_exec = mode != "plain"
+
             def _compile(key, lowered, dummy_args_fn):
                 try:
                     compiled = lowered.compile()
                     built[key] = compiled
-                    try:
-                        jax.block_until_ready(compiled(*dummy_args_fn()))
-                    except Exception:
-                        pass  # warm-exec is best-effort
+                    if warm_exec:
+                        try:
+                            jax.block_until_ready(compiled(*dummy_args_fn()))
+                        except Exception:
+                            pass  # warm-exec is best-effort
                 except Exception as e:  # pragma: no cover - best-effort
                     built[key] = e
 
