@@ -725,6 +725,29 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 stacks[stack_key] = (stacked, treedef, aero_axes)
 
         with profiling.phase("sweep/chunks"):
+            # software-pipelined with bounded depth: chunk k+1's transfers
+            # and executables are queued before chunk k's results are
+            # fetched, hiding the host->device->host round trips behind
+            # execution (which matters when the chip sits behind a network
+            # tunnel) — but never more than _PIPELINE chunks are in flight,
+            # so device memory stays bounded and per-chunk checkpoint
+            # commits lag at most one chunk behind dispatch.
+            _PIPELINE = 2
+            pending = []
+
+            def _commit(entry):
+                start, stop, n_real, std, a_std, pr = entry
+                results[start:stop] = np.asarray(std)[:n_real]
+                nacelle_acc[start:stop] = np.asarray(a_std)[:n_real]
+                for k in props:
+                    props[k][start:stop] = np.asarray(pr[k])[:n_real]
+                done[start:stop] = True
+                if display:
+                    print(f"sweep: designs {start+1}-{stop}/{n_designs} done")
+                if checkpoint:
+                    _save_checkpoint(checkpoint, sig, results, done, props,
+                                     nacelle_acc)
+
             for start in range(0, n_designs, chunk_size):
                 stop = min(start + chunk_size, n_designs)
                 if done[start:stop].all():
@@ -753,16 +776,11 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                         std, a_std = cB(params, zetas, betas,
                                         {k: sel_variants[k] for k in ("A", "B", "zh")},
                                         av_dev)
-                results[start:stop] = np.asarray(std)[:n_real]
-                nacelle_acc[start:stop] = np.asarray(a_std)[:n_real]
-                for k in props:
-                    props[k][start:stop] = np.asarray(pr[k])[:n_real]
-                done[start:stop] = True
-                if display:
-                    print(f"sweep: designs {start+1}-{stop}/{n_designs} done")
-                if checkpoint:
-                    _save_checkpoint(checkpoint, sig, results, done, props,
-                                     nacelle_acc)
+                pending.append((start, stop, n_real, std, a_std, pr))
+                while len(pending) >= _PIPELINE:
+                    _commit(pending.pop(0))
+            for entry in pending:
+                _commit(entry)
         return {"grid": combos, "motion_std": results,
                 "AxRNA_std": nacelle_acc, **props}
 
