@@ -1,0 +1,8 @@
+"""Repo-root pytest configuration.
+
+Registers the graftlint pytest plugin (lint gate, recompile sentinel,
+``compile_budget``/``sentinel`` markers, ``sentinel`` fixture).  Must
+live at the rootdir: pytest only honors ``pytest_plugins`` here.
+"""
+
+pytest_plugins = ["raft_tpu.analysis.pytest_plugin"]

@@ -1,0 +1,27 @@
+"""Static analysis + trace-contract tooling for raft-tpu (graftlint).
+
+The reference RAFT is plain NumPy; this framework lives or dies on JAX
+tracing discipline — a stray ``np.`` call inside a jitted region, a
+Python branch on a traced value, a float64 literal in a kernel, or an
+accidental host sync silently costs recompiles and device round trips.
+This package enforces that discipline mechanically, in three layers:
+
+1. :mod:`.graftlint` — an AST linter with JAX-specific rules (taint
+   walk from traced parameters; see ``docs/analysis.md`` for rule IDs),
+   runnable as ``python -m raft_tpu.analysis.graftlint raft_tpu/``.
+2. :mod:`.contracts` — the :func:`shape_contract` decorator: declared
+   shape signatures for the hot kernels, verified once per distinct
+   input signature (trace-time cheap; ``jax.eval_shape``-based static
+   verification for tests).
+3. :mod:`.recompile` — :class:`RecompileSentinel`, a jit-cache-miss
+   counter wired into pytest via :mod:`.pytest_plugin` so a test can
+   assert "the second identical call compiles nothing".
+"""
+
+from .contracts import (  # noqa: F401
+    ShapeContractError,
+    contracts_enabled,
+    shape_contract,
+    verify_contract,
+)
+from .recompile import RecompileSentinel  # noqa: F401

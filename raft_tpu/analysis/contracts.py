@@ -1,0 +1,206 @@
+"""Runtime shape contracts for traced kernels.
+
+A kernel declares its shape signature once::
+
+    @shape_contract("[N,6],[6,nw]->[N,nw]")
+    def apply(P, Xi): ...
+
+and every *distinct* input signature is verified exactly once: dimension
+variables (``N``, ``nw``) must bind consistently across arguments and
+outputs, integer literals must match exactly.  Verified signatures are
+memoized, so steady-state cost is one dict lookup per call — and inside
+``jit`` the wrapper only runs at trace time anyway, where shapes are
+static on the tracers (the same information ``jax.eval_shape`` would
+produce; :func:`verify_contract` exposes that eval-shape path directly
+for tests that want to check a kernel without executing it).
+
+Spec grammar (comma-separated argument specs, ``->``, comma-separated
+output specs)::
+
+    spec    := '_'                 skip this argument (any pytree)
+             | '[' dims ']'        an array of the given shape
+    dims    := ''                  scalar (shape ())
+             | '*,' dims           any number of leading batch dims
+             | dim (',' dim)*
+    dim     := INT                 exact extent
+             | '_'                 any single extent
+             | NAME                dimension variable (binds on first use)
+
+Contracts check shapes only (dtypes stay the business of the config
+layer).  Disable globally with ``RAFT_TPU_CONTRACTS=0`` (e.g. for
+micro-benchmarks of eager call overhead).
+"""
+
+from __future__ import annotations
+
+import functools
+import os
+import re
+
+import numpy as np
+
+__all__ = ["shape_contract", "verify_contract", "ShapeContractError",
+           "contracts_enabled"]
+
+
+class ShapeContractError(TypeError):
+    """An argument or output violated its declared shape contract."""
+
+
+_SKIP = object()  # sentinel parsed from a bare '_' argument spec
+_DIM_RE = re.compile(r"^(\*|_|\d+|[A-Za-z][A-Za-z0-9_]*)$")
+
+
+def _split_top(s):
+    """Split on commas not nested inside brackets."""
+    parts, depth, cur = [], 0, []
+    for ch in s:
+        if ch == "[":
+            depth += 1
+        elif ch == "]":
+            depth -= 1
+        if ch == "," and depth == 0:
+            parts.append("".join(cur))
+            cur = []
+        else:
+            cur.append(ch)
+    parts.append("".join(cur))
+    return [p.strip() for p in parts]
+
+
+def _parse_one(spec):
+    if spec == "_":
+        return _SKIP
+    if not (spec.startswith("[") and spec.endswith("]")):
+        raise ValueError(f"bad shape spec {spec!r}: expected '[dims]' or '_'")
+    inner = spec[1:-1].strip()
+    dims = [] if inner == "" else [d.strip() for d in inner.split(",")]
+    for i, d in enumerate(dims):
+        if not _DIM_RE.match(d):
+            raise ValueError(f"bad dim {d!r} in spec {spec!r}")
+        if d == "*" and i != 0:
+            raise ValueError(f"'*' must lead the dim list in {spec!r}")
+    return tuple(dims)
+
+
+def _parse(contract):
+    if "->" in contract:
+        left, right = contract.split("->", 1)
+        out_specs = [_parse_one(s) for s in _split_top(right)]
+        if any(s is _SKIP for s in out_specs):
+            raise ValueError("'_' is not meaningful on the output side")
+    else:
+        left, out_specs = contract, None
+    arg_specs = [_parse_one(s) for s in _split_top(left)] if left.strip() else []
+    return arg_specs, out_specs
+
+
+def _match(spec, shape, bindings, what):
+    dims = list(spec)
+    shape = tuple(shape)
+    if dims and dims[0] == "*":
+        dims = dims[1:]
+        if len(shape) < len(dims):
+            raise ShapeContractError(
+                f"{what}: shape {shape} has fewer than the {len(dims)} "
+                f"trailing dims required by spec [{','.join(spec)}]")
+        shape = shape[len(shape) - len(dims):]
+    elif len(shape) != len(dims):
+        raise ShapeContractError(
+            f"{what}: rank {len(shape)} shape {shape} does not match "
+            f"spec [{','.join(spec)}]")
+    for d, n in zip(dims, shape):
+        if d == "_":
+            continue
+        if d.isdigit():
+            if int(d) != n:
+                raise ShapeContractError(
+                    f"{what}: dim {n} != literal {d} "
+                    f"(shape {shape}, spec [{','.join(spec)}])")
+        elif d in bindings:
+            if bindings[d] != n:
+                raise ShapeContractError(
+                    f"{what}: dim variable {d}={bindings[d]} rebinds to {n} "
+                    f"(shape {shape}, spec [{','.join(spec)}])")
+        else:
+            bindings[d] = n
+
+
+def _shape_of(x):
+    # works for np arrays, jnp arrays, tracers, and python scalars alike;
+    # jax tracer shapes are static, so this is trace-time information
+    shape = getattr(x, "shape", None)
+    if shape is None:
+        shape = np.shape(x)
+    return tuple(shape)
+
+
+def contracts_enabled():
+    return os.environ.get("RAFT_TPU_CONTRACTS", "1") not in ("0", "false", "")
+
+
+def shape_contract(contract):
+    """Decorator attaching (and enforcing) a shape contract to a kernel.
+
+    The contract string covers the leading positional arguments (extra
+    positionals and all keywords pass through unchecked; use ``_`` to
+    skip a leading arg such as a params pytree) and, after ``->``, the
+    output — one spec per element for tuple returns.
+    """
+    arg_specs, out_specs = _parse(contract)
+    checked = [i for i, s in enumerate(arg_specs) if s is not _SKIP]
+
+    def deco(fn):
+        verified: set = set()
+
+        @functools.wraps(fn)
+        def wrapper(*args, **kwargs):
+            if not contracts_enabled() or len(args) < len(arg_specs):
+                # too few positionals: some contracted args came in by
+                # keyword; stay permissive rather than guessing names
+                return fn(*args, **kwargs)
+            key = tuple(_shape_of(args[i]) for i in checked)
+            if key in verified:
+                return fn(*args, **kwargs)
+            bindings: dict = {}
+            name = getattr(fn, "__qualname__", getattr(fn, "__name__", "?"))
+            for ci, i in enumerate(checked):
+                _match(arg_specs[i], key[ci], bindings, f"{name}() arg {i}")
+            out = fn(*args, **kwargs)
+            if out_specs is not None:
+                outs = out if isinstance(out, tuple) else (out,)
+                if len(outs) < len(out_specs):
+                    raise ShapeContractError(
+                        f"{name}() returned {len(outs)} value(s); contract "
+                        f"declares {len(out_specs)}")
+                for j, spec in enumerate(out_specs):
+                    _match(spec, _shape_of(outs[j]), bindings,
+                           f"{name}() output {j}")
+            if len(verified) < 512:  # bound the memo for shape-churny callers
+                verified.add(key)
+            return out
+
+        wrapper.__shape_contract__ = contract
+        return wrapper
+
+    return deco
+
+
+def verify_contract(fn, *args, **kwargs):
+    """Statically verify ``fn``'s contract on example inputs.
+
+    Runs ``jax.eval_shape`` — abstract evaluation only, no FLOPs, always
+    on the host — so a test can check a kernel's contract against real
+    argument shapes without executing it.  ``fn`` must carry a
+    ``__shape_contract__`` (i.e. be decorated with
+    :func:`shape_contract`).  Returns the eval_shape result.
+    """
+    import jax
+
+    contract = getattr(fn, "__shape_contract__", None)
+    if contract is None:
+        raise ValueError(f"{fn!r} has no __shape_contract__")
+    # eval_shape re-enters the wrapper with ShapeDtypeStruct-like
+    # tracers, so the contract check happens inside it; a violation
+    # surfaces as ShapeContractError from this call
+    return jax.eval_shape(fn, *args, **kwargs)

@@ -1,0 +1,963 @@
+"""graftlint: JAX/TPU trace-discipline linter for raft-tpu.
+
+AST-based, no imports of the linted code.  The analysis has two parts:
+
+1. **Trace reachability** — which functions run under a JAX trace.
+   Seeds: functions passed to ``jax.jit``/``vmap``/``pjit``/``pmap``/
+   ``lax.scan``/``while_loop``/``cond``/``fori_loop``/``shard_map``
+   (including lambdas), functions decorated with ``@jax.jit`` /
+   ``@partial(jax.jit, ...)`` / ``@shape_contract(...)``, rebinding
+   assignments like ``f = jax.jit(f)``, and names listed under
+   ``[lint] extra_trace_roots`` in ``graftlint.toml`` or marked with a
+   ``# graftlint: traced`` comment on their ``def`` line.  The set then
+   closes transitively over same-module calls resolvable by name.
+
+2. **Taint walk** — inside each traced function, every parameter (and
+   every name tainted in an enclosing traced function) is a traced
+   value; taint propagates through assignments and expressions but NOT
+   through ``.shape``/``.ndim``/``.dtype``/``.size``/``len()``, which
+   are static under tracing.  Rules fire on tainted values only, so
+   host-side constant math (``np.log(np.finfo(...).max)``) stays legal
+   inside a kernel.
+
+Rules (see docs/analysis.md):
+
+==============  ============================================================
+GL-NP-IN-JIT    ``np.*`` / ``math.*`` call on a traced value inside a
+                trace-reachable function (breaks tracing or silently
+                host-syncs).
+GL-HOST-CAST    ``float()``/``int()``/``bool()``/``.item()``/``.tolist()``/
+                ``np.asarray()``/``np.array()`` on a traced value (forces a
+                device round trip / ConcretizationTypeError).
+GL-PY-BRANCH    Python ``if``/``while``/``assert``/ternary/``and``/``or``
+                on a traced value (trace-time concretization).
+GL-BARE-EXCEPT  ``except:`` or ``except Exception:`` whose body is only
+                ``pass`` — swallows device/compile failures silently.
+GL-STATIC-ARGS  ``static_argnums``/``static_argnames`` given unhashable or
+                array-valued literals (every call becomes a cache miss or a
+                TypeError).
+GL-F64-LITERAL  dtype-widening literal (``float64``/``complex128``) inside
+                a traced function in a kernel dir (``ops/``, ``hydro/``,
+                ``parallel/``) outside a dtype-conditional expression.
+GL-NESTED-JIT   ``jax.jit``/``pjit``/``pmap`` called inside a traced
+                function (a fresh wrapper per outer trace defeats the jit
+                cache).
+==============  ============================================================
+
+Suppression: trailing ``# graftlint: disable=GL-XXX[,GL-YYY]`` on the
+flagged line, or a checked-in per-(file, rule) baseline count in
+``graftlint.toml`` that can only ratchet down (``--update-baseline``
+rewrites it after fixes).
+
+CLI::
+
+    python -m raft_tpu.analysis.graftlint raft_tpu/ [--config graftlint.toml]
+        [--update-baseline] [--no-baseline] [-q]
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import os
+import sys
+import tokenize
+from dataclasses import dataclass, field
+
+ALL_RULES = (
+    "GL-NP-IN-JIT",
+    "GL-HOST-CAST",
+    "GL-PY-BRANCH",
+    "GL-BARE-EXCEPT",
+    "GL-STATIC-ARGS",
+    "GL-F64-LITERAL",
+    "GL-NESTED-JIT",
+)
+
+# call sites whose function-valued arguments run under a trace.  Maps the
+# terminal attribute/name to the positions of function arguments
+# (None = every positional argument may be a traced callable).
+_TRACE_ENTRY_FUNCS = {
+    "jit": (0,),
+    "pjit": (0,),
+    "vmap": (0,),
+    "pmap": (0,),
+    "grad": (0,),
+    "value_and_grad": (0,),
+    "jacfwd": (0,),
+    "jacrev": (0,),
+    "hessian": (0,),
+    "checkpoint": (0,),
+    "remat": (0,),
+    "eval_shape": (0,),
+    "named_call": (0,),
+    "shard_map": (0,),
+    "custom_jvp": (0,),
+    "custom_vjp": (0,),
+    "scan": (0,),
+    "while_loop": (0, 1),
+    "fori_loop": (2,),
+    "cond": (1, 2, 3),
+    "switch": None,
+    "map": (0,),
+    "associated_scan": (0,),
+    "associative_scan": (0,),
+}
+
+# jit-family wrappers: decorating/rebinding with these marks the wrapped
+# function itself as traced AND (inside a traced fn) is a GL-NESTED-JIT
+_JIT_FUNCS = {"jit", "pjit", "pmap"}
+
+# attributes that read static (trace-time-known) metadata off a tracer
+_STATIC_ATTRS = {"shape", "ndim", "dtype", "size", "aval", "sharding",
+                 "weak_type"}
+
+# np./math. attributes that stay host-side even on tracer-derived
+# metadata (np.shape(x) etc. return static info under tracing)
+_NP_STATIC_FUNCS = {"shape", "ndim", "size", "dtype", "result_type",
+                    "finfo", "iinfo", "broadcast_shapes"}
+
+_HOST_CAST_BUILTINS = {"float", "int", "bool", "complex"}
+_HOST_CAST_METHODS = {"item", "tolist", "to_py", "__array__"}
+_NP_CAST_FUNCS = {"asarray", "array", "ascontiguousarray", "copy"}
+
+_WIDE_DTYPES = {"float64", "complex128"}
+
+
+@dataclass
+class Violation:
+    path: str
+    line: int
+    col: int
+    rule: str
+    message: str
+
+    def __str__(self):
+        return f"{self.path}:{self.line}:{self.col + 1}: {self.rule} {self.message}"
+
+
+@dataclass
+class Config:
+    kernel_dirs: tuple = ("ops", "hydro", "parallel")
+    extra_trace_roots: tuple = ()
+    baseline: dict = field(default_factory=dict)
+    sentinel: dict = field(default_factory=dict)
+
+
+def load_config(path):
+    """Load graftlint.toml (tomli).  Missing file -> defaults."""
+    cfg = Config()
+    if path is None or not os.path.exists(path):
+        return cfg
+    import tomli
+
+    with open(path, "rb") as f:
+        data = tomli.load(f)
+    lint = data.get("lint", {})
+    cfg.kernel_dirs = tuple(lint.get("kernel_dirs", cfg.kernel_dirs))
+    cfg.extra_trace_roots = tuple(lint.get("extra_trace_roots", ()))
+    cfg.baseline = dict(data.get("baseline", {}))
+    cfg.sentinel = dict(data.get("sentinel", {}))
+    return cfg
+
+
+# ---------------------------------------------------------------------------
+# comment directives
+# ---------------------------------------------------------------------------
+
+
+def _collect_directives(source):
+    """Map line -> set of disabled rules; lines marked '# graftlint:
+    traced' (trace-root markers on def lines); and line -> set of
+    parameter names declared static via '# graftlint: static=a,b' (a
+    def-line directive: those params hold config/topology objects that
+    are hashable constants under tracing, so they do not taint)."""
+    disabled: dict = {}
+    traced_lines = set()
+    static_params: dict = {}
+    try:
+        toks = tokenize.generate_tokens(io.StringIO(source).readline)
+        for tok in toks:
+            if tok.type != tokenize.COMMENT:
+                continue
+            text = tok.string.lstrip("#").strip()
+            if not text.startswith("graftlint:"):
+                continue
+            body = text[len("graftlint:"):].strip()
+            if body == "traced":
+                traced_lines.add(tok.start[0])
+            elif body.startswith("disable="):
+                rules = {r.strip() for r in body[len("disable="):].split(",")}
+                disabled.setdefault(tok.start[0], set()).update(rules)
+            elif body.startswith("static="):
+                names = {n.strip() for n in body[len("static="):].split(",")
+                         if n.strip()}
+                static_params.setdefault(tok.start[0], set()).update(names)
+    except tokenize.TokenError:
+        pass
+    return disabled, traced_lines, static_params
+
+
+# ---------------------------------------------------------------------------
+# AST helpers
+# ---------------------------------------------------------------------------
+
+
+def _call_root_and_attr(func):
+    """('np', 'asarray') for np.asarray; ('jax', 'jit') for jax.jit;
+    (None, 'jit') for bare jit; follows arbitrary attribute depth using
+    the outermost name as root and the final attr."""
+    if isinstance(func, ast.Name):
+        return None, func.id
+    if isinstance(func, ast.Attribute):
+        node = func
+        while isinstance(node, ast.Attribute):
+            node = node.value
+        root = node.id if isinstance(node, ast.Name) else None
+        return root, func.attr
+    return None, None
+
+
+def _collect_import_aliases(tree):
+    """Alias sets for numpy / math / jax (incl. jax.numpy as jnp etc.)."""
+    aliases = {"numpy": set(), "math": set(), "jax": set(), "jnp": set(),
+               "functools": set()}
+    for node in ast.walk(tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                name = a.asname or a.name.split(".")[0]
+                if a.name == "numpy" or a.name.startswith("numpy."):
+                    aliases["numpy"].add(name if a.asname else "numpy")
+                if a.name == "math":
+                    aliases["math"].add(name)
+                if a.name == "jax":
+                    aliases["jax"].add(name)
+                if a.name == "jax.numpy":
+                    aliases["jnp"].add(a.asname or "jax")
+                if a.name == "functools":
+                    aliases["functools"].add(name)
+        elif isinstance(node, ast.ImportFrom):
+            mod = node.module or ""
+            for a in node.names:
+                name = a.asname or a.name
+                if mod == "jax" and a.name == "numpy":
+                    aliases["jnp"].add(name)
+                if mod == "jax" or mod.startswith("jax."):
+                    # from jax import jit / from jax.experimental import ...
+                    aliases["jax"].add(name)
+    return aliases
+
+
+class _FuncInfo:
+    __slots__ = ("node", "qualname", "parent", "traced", "reason")
+
+    def __init__(self, node, qualname, parent):
+        self.node = node
+        self.qualname = qualname
+        self.parent = parent  # enclosing _FuncInfo or None
+        self.traced = False
+        self.reason = None
+
+
+def _index_functions(tree):
+    """All FunctionDef/AsyncFunctionDef/Lambda nodes with qualnames and
+    lexical parents."""
+    infos: dict = {}  # id(node) -> _FuncInfo
+
+    def visit(node, parent, prefix):
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                fi = _FuncInfo(child, qn, parent)
+                infos[id(child)] = fi
+                visit(child, fi, qn + ".")
+            elif isinstance(child, ast.Lambda):
+                fi = _FuncInfo(child, f"{prefix}<lambda>", parent)
+                infos[id(child)] = fi
+                visit(child, fi, prefix)
+            elif isinstance(child, ast.ClassDef):
+                visit(child, parent, f"{prefix}{child.name}.")
+            else:
+                visit(child, parent, prefix)
+
+    visit(tree, None, "")
+    return infos
+
+
+def _name_scope_map(infos):
+    """(parent, name) -> _FuncInfo for def nodes, used to resolve calls
+    by simple name within the same lexical scope chain."""
+    by_scope = {}
+    for fi in infos.values():
+        if isinstance(fi.node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            by_scope[(id(fi.parent) if fi.parent else None, fi.node.name)] = fi
+    return by_scope
+
+
+def _mark_traced(fi, reason):
+    if not fi.traced:
+        fi.traced = True
+        fi.reason = reason
+        return True
+    return False
+
+
+def _resolve_callable_arg(arg, infos, scope_fi, by_scope):
+    """A function-valued argument at a trace entry point: return the
+    _FuncInfo it refers to (Name resolving to a def in the enclosing
+    scope chain, or an inline Lambda), else None."""
+    if isinstance(arg, ast.Lambda):
+        return infos.get(id(arg))
+    if isinstance(arg, ast.Name):
+        p = scope_fi
+        while True:
+            fi = by_scope.get((id(p) if p else None, arg.id))
+            if fi is not None:
+                return fi
+            if p is None:
+                return None
+            p = p.parent
+    if isinstance(arg, ast.Call):
+        # partial(f, ...) / functools.partial(f, ...): unwrap first arg
+        root, attr = _call_root_and_attr(arg.func)
+        if attr == "partial" and arg.args:
+            return _resolve_callable_arg(arg.args[0], infos, scope_fi, by_scope)
+    return None
+
+
+def _decorator_traces(dec, aliases):
+    """True if a decorator marks the function as trace-reachable."""
+    node = dec
+    if isinstance(node, ast.Call):
+        root, attr = _call_root_and_attr(node.func)
+        if attr == "partial" and node.args:
+            return _decorator_traces(node.args[0], aliases)
+        return attr in _JIT_FUNCS or attr == "shape_contract"
+    root, attr = _call_root_and_attr(node)
+    return attr in _JIT_FUNCS or attr == "shape_contract"
+
+
+def _seed_traced(tree, infos, by_scope, aliases, traced_lines,
+                 extra_roots, modname):
+    # decorators + '# graftlint: traced' markers
+    for fi in infos.values():
+        node = fi.node
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            if node.lineno in traced_lines:
+                _mark_traced(fi, "marked '# graftlint: traced'")
+            for dec in node.decorator_list:
+                if _decorator_traces(dec, aliases):
+                    _mark_traced(fi, "jit/shape_contract decorator")
+            full = f"{modname}.{fi.qualname}" if modname else fi.qualname
+            if fi.qualname in extra_roots or full in extra_roots:
+                _mark_traced(fi, "extra_trace_roots")
+
+    # call sites: jax.jit(f), vmap(f), lax.scan(body, ...), f = jax.jit(f)
+    class SiteVisitor(ast.NodeVisitor):
+        def __init__(self):
+            self.scope = None
+
+        def _enter(self, node):
+            prev, self.scope = self.scope, infos.get(id(node), self.scope)
+            self.generic_visit(node)
+            self.scope = prev
+
+        visit_FunctionDef = visit_AsyncFunctionDef = visit_Lambda = _enter
+
+        def visit_Call(self, node):
+            root, attr = _call_root_and_attr(node.func)
+            positions = _TRACE_ENTRY_FUNCS.get(attr)
+            if attr in _TRACE_ENTRY_FUNCS:
+                args = node.args
+                idxs = range(len(args)) if positions is None else positions
+                for i in idxs:
+                    if i < len(args):
+                        fi = _resolve_callable_arg(args[i], infos, self.scope,
+                                                   by_scope)
+                        if fi is not None:
+                            _mark_traced(fi, f"passed to {attr}()")
+            self.generic_visit(node)
+
+    SiteVisitor().visit(tree)
+
+
+def _close_over_calls(infos, by_scope):
+    """Propagate: functions called (by resolvable name) from a traced
+    function are traced too."""
+    changed = True
+    while changed:
+        changed = False
+        for fi in list(infos.values()):
+            if not fi.traced:
+                continue
+            for node in ast.walk(fi.node):
+                if isinstance(node, ast.Call):
+                    callee = _resolve_callable_arg(node.func, infos, fi,
+                                                   by_scope)
+                    if callee is not None and not callee.traced:
+                        _mark_traced(callee, f"called from {fi.qualname}")
+                        changed = True
+
+
+# ---------------------------------------------------------------------------
+# taint walk + rule checks inside traced functions
+# ---------------------------------------------------------------------------
+
+
+class _Taint:
+    """Conservative forward taint over one function body."""
+
+    def __init__(self, fn_node, inherited=(), static_names=()):
+        self.tainted = set(inherited)
+        skip = {"self"} | set(static_names)
+        args = fn_node.args
+        for a in (list(args.posonlyargs) + list(args.args)
+                  + list(args.kwonlyargs)
+                  + ([args.vararg] if args.vararg else [])
+                  + ([args.kwarg] if args.kwarg else [])):
+            if a.arg not in skip:
+                self.tainted.add(a.arg)
+        self.tainted -= set(static_names)
+
+    def expr_tainted(self, node):
+        t = self.tainted
+        if isinstance(node, ast.Name):
+            return node.id in t
+        if isinstance(node, ast.Attribute):
+            if node.attr in _STATIC_ATTRS:
+                return False
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Subscript):
+            # x.shape[0] is static even though x is traced
+            return self.expr_tainted(node.value)
+        if isinstance(node, ast.Call):
+            root, attr = _call_root_and_attr(node.func)
+            if attr == "len" and root is None:
+                return False
+            if attr in _NP_STATIC_FUNCS:
+                return False
+            parts = list(node.args) + [kw.value for kw in node.keywords]
+            if isinstance(node.func, ast.Attribute):
+                parts.append(node.func.value)  # method call on a tracer
+            return any(self.expr_tainted(p) for p in parts)
+        if isinstance(node, (ast.BinOp,)):
+            return self.expr_tainted(node.left) or self.expr_tainted(node.right)
+        if isinstance(node, ast.UnaryOp):
+            return self.expr_tainted(node.operand)
+        if isinstance(node, ast.BoolOp):
+            return any(self.expr_tainted(v) for v in node.values)
+        if isinstance(node, ast.Compare):
+            # identity and membership tests are host-side operations on
+            # python objects (x is None, "k" in d) — never traced values
+            if all(isinstance(op, (ast.Is, ast.IsNot, ast.In, ast.NotIn))
+                   for op in node.ops):
+                return False
+            return (self.expr_tainted(node.left)
+                    or any(self.expr_tainted(c) for c in node.comparators))
+        if isinstance(node, ast.IfExp):
+            return (self.expr_tainted(node.body)
+                    or self.expr_tainted(node.orelse))
+        if isinstance(node, (ast.Tuple, ast.List, ast.Set)):
+            return any(self.expr_tainted(e) for e in node.elts)
+        if isinstance(node, ast.Dict):
+            return any(self.expr_tainted(v) for v in node.values if v)
+        if isinstance(node, ast.Starred):
+            return self.expr_tainted(node.value)
+        if isinstance(node, (ast.GeneratorExp, ast.ListComp, ast.SetComp,
+                             ast.DictComp)):
+            return any(self.expr_tainted(g.iter) for g in node.generators)
+        if isinstance(node, ast.JoinedStr):
+            return False
+        return False
+
+    def _taint_target(self, target):
+        if isinstance(target, ast.Name):
+            self.tainted.add(target.id)
+        elif isinstance(target, (ast.Tuple, ast.List)):
+            for e in target.elts:
+                self._taint_target(e)
+        elif isinstance(target, ast.Starred):
+            self._taint_target(target.value)
+        # Attribute/Subscript targets: container already tracked by name
+
+    def process_assign(self, node):
+        if isinstance(node, ast.Assign):
+            if self.expr_tainted(node.value):
+                for tgt in node.targets:
+                    self._taint_target(tgt)
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            if self.expr_tainted(node.value):
+                self._taint_target(node.target)
+        elif isinstance(node, ast.AugAssign):
+            if self.expr_tainted(node.value) or self.expr_tainted(node.target):
+                self._taint_target(node.target)
+        elif isinstance(node, ast.NamedExpr):
+            if self.expr_tainted(node.value):
+                self._taint_target(node.target)
+        elif isinstance(node, ast.For):
+            # literal tuple-of-tuples iteration with tuple unpacking gets
+            # element-wise taint: `for idx, F in ((i_a, F_A), (i_b, F_B))`
+            # only taints the slots whose column has a tainted element
+            if (isinstance(node.iter, (ast.Tuple, ast.List))
+                    and isinstance(node.target, (ast.Tuple, ast.List))
+                    and node.iter.elts
+                    and all(isinstance(e, (ast.Tuple, ast.List))
+                            and len(e.elts) == len(node.target.elts)
+                            for e in node.iter.elts)):
+                for col, tgt in enumerate(node.target.elts):
+                    if any(self.expr_tainted(row.elts[col])
+                           for row in node.iter.elts):
+                        self._taint_target(tgt)
+            elif self.expr_tainted(node.iter):
+                self._taint_target(node.target)
+        elif isinstance(node, (ast.withitem,)):
+            if node.optional_vars is not None and self.expr_tainted(
+                    node.context_expr):
+                self._taint_target(node.optional_vars)
+
+
+class _TracedFunctionChecker(ast.NodeVisitor):
+    """Runs the taint-aware rules over ONE traced function body (without
+    descending into nested function defs — they are checked separately,
+    inheriting this scope's taint)."""
+
+    def __init__(self, linter, fn_info, inherited_taint=()):
+        self.linter = linter
+        self.fi = fn_info
+        node = fn_info.node
+        # a `# graftlint: static=a,b` directive anywhere on the def
+        # header (which may span lines) excludes those params from taint
+        static = set()
+        body_start = node.body.lineno if isinstance(node, ast.Lambda) \
+            else node.body[0].lineno
+        for line in range(node.lineno, body_start + 1):
+            static |= linter.static_params.get(line, set())
+        self.taint = _Taint(node, inherited_taint, static)
+        self.own = node
+
+    def _walk_own(self, node):
+        """ast.walk, but stopping at nested function boundaries (nested
+        defs are analyzed as their own scopes)."""
+        stack = [node]
+        while stack:
+            n = stack.pop()
+            yield n
+            for child in ast.iter_child_nodes(n):
+                if not self._is_nested_fn(child):
+                    stack.append(child)
+
+    def run(self):
+        node = self.own
+        body = node.body if not isinstance(node, ast.Lambda) else [
+            ast.Expr(value=node.body)]
+        # two passes: taint fixpoint first (handles use-before-later-def
+        # inside loops), then rule checks with the final taint set
+        for _ in range(2):
+            before = len(self.taint.tainted)
+            for stmt in body:
+                for n in self._walk_own(stmt):
+                    self.taint.process_assign(n)
+            if len(self.taint.tainted) == before:
+                break
+        for stmt in body:
+            self.visit(stmt)
+        return self.taint.tainted
+
+    def _is_nested_fn(self, node):
+        return (isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                  ast.Lambda))
+                and node is not self.own)
+
+    def generic_visit(self, node):
+        for child in ast.iter_child_nodes(node):
+            if self._is_nested_fn(child):
+                continue  # analyzed as its own (possibly traced) function
+            self.visit(child)
+
+    # ---- rules ----
+
+    def visit_If(self, node):
+        if self.taint.expr_tainted(node.test):
+            self.linter.report(node, "GL-PY-BRANCH",
+                               "Python `if` on a traced value inside "
+                               f"traced function {self.fi.qualname!r} "
+                               "(use jnp.where / lax.cond)")
+        self.generic_visit(node)
+
+    def visit_While(self, node):
+        if self.taint.expr_tainted(node.test):
+            self.linter.report(node, "GL-PY-BRANCH",
+                               "Python `while` on a traced value inside "
+                               f"traced function {self.fi.qualname!r} "
+                               "(use lax.while_loop)")
+        self.generic_visit(node)
+
+    def visit_Assert(self, node):
+        if self.taint.expr_tainted(node.test):
+            self.linter.report(node, "GL-PY-BRANCH",
+                               "assert on a traced value inside traced "
+                               f"function {self.fi.qualname!r} "
+                               "(use checkify or debug.check)")
+        self.generic_visit(node)
+
+    def visit_IfExp(self, node):
+        if self.taint.expr_tainted(node.test):
+            self.linter.report(node, "GL-PY-BRANCH",
+                               "ternary on a traced value inside traced "
+                               f"function {self.fi.qualname!r} "
+                               "(use jnp.where)")
+        self.generic_visit(node)
+
+    def visit_BoolOp(self, node):
+        if any(self.taint.expr_tainted(v) for v in node.values):
+            self.linter.report(node, "GL-PY-BRANCH",
+                               "`and`/`or` on a traced value inside traced "
+                               f"function {self.fi.qualname!r} "
+                               "(use jnp.logical_and/or)")
+        self.generic_visit(node)
+
+    def visit_Call(self, node):
+        lint = self.linter
+        root, attr = _call_root_and_attr(node.func)
+        aliases = lint.aliases
+        np_rooted = root in aliases["numpy"]
+        math_rooted = root in aliases["math"]
+        any_tainted_arg = any(self.taint.expr_tainted(a) for a in node.args) \
+            or any(self.taint.expr_tainted(k.value) for k in node.keywords)
+
+        if (np_rooted or math_rooted) and attr not in _NP_STATIC_FUNCS:
+            if any_tainted_arg:
+                if np_rooted and attr in _NP_CAST_FUNCS:
+                    lint.report(node, "GL-HOST-CAST",
+                                f"np.{attr}() on a traced value inside "
+                                f"traced function {self.fi.qualname!r} "
+                                "forces a host transfer (use jnp)")
+                else:
+                    mod = "np" if np_rooted else "math"
+                    lint.report(node, "GL-NP-IN-JIT",
+                                f"{mod}.{attr}() on a traced value inside "
+                                f"traced function {self.fi.qualname!r} "
+                                "(use jax.numpy)")
+
+        if root is None and attr in _HOST_CAST_BUILTINS and any_tainted_arg:
+            lint.report(node, "GL-HOST-CAST",
+                        f"{attr}() on a traced value inside traced "
+                        f"function {self.fi.qualname!r} concretizes the "
+                        "tracer (device sync / trace error)")
+
+        if (isinstance(node.func, ast.Attribute)
+                and node.func.attr in _HOST_CAST_METHODS
+                and self.taint.expr_tainted(node.func.value)):
+            lint.report(node, "GL-HOST-CAST",
+                        f".{node.func.attr}() on a traced value inside "
+                        f"traced function {self.fi.qualname!r} forces a "
+                        "host transfer")
+
+        if attr in _JIT_FUNCS and (
+                root in aliases["jax"]
+                or (root is None and attr in aliases["jax"])):
+            lint.report(node, "GL-NESTED-JIT",
+                        f"jax.{attr}() inside traced function "
+                        f"{self.fi.qualname!r}: the wrapper is rebuilt "
+                        "per outer trace, defeating the jit cache")
+
+        self.generic_visit(node)
+
+
+class _FileLinter:
+    def __init__(self, path, source, cfg, relpath=None):
+        self.path = path
+        self.relpath = relpath or path
+        self.source = source
+        self.cfg = cfg
+        self.violations: list = []
+        self.disabled, self.traced_lines, self.static_params = \
+            _collect_directives(source)
+        self.tree = ast.parse(source, filename=path)
+        self.aliases = _collect_import_aliases(self.tree)
+        self.suppressed = 0
+        self._seen = set()
+
+    def report(self, node, rule, message):
+        line = getattr(node, "lineno", 0)
+        if rule in self.disabled.get(line, ()):
+            self.suppressed += 1
+            return
+        if (line, rule) in self._seen:  # e.g. `if a and b:` fires once
+            return
+        self._seen.add((line, rule))
+        self.violations.append(
+            Violation(self.relpath, line, getattr(node, "col_offset", 0),
+                      rule, message))
+
+    # ---- whole-file rules ----
+
+    def _check_bare_except(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.ExceptHandler):
+                continue
+            broad = node.type is None or (
+                isinstance(node.type, ast.Name)
+                and node.type.id in ("Exception", "BaseException"))
+            body_is_pass = all(isinstance(s, ast.Pass) for s in node.body)
+            if broad and body_is_pass:
+                what = "bare `except:`" if node.type is None else \
+                    f"`except {node.type.id}:`"
+                self.report(node, "GL-BARE-EXCEPT",
+                            f"{what} with a pass-only body swallows "
+                            "device/compile failures; record or re-raise")
+
+    def _check_static_args(self):
+        for node in ast.walk(self.tree):
+            if not isinstance(node, ast.Call):
+                continue
+            for kw in node.keywords:
+                if kw.arg not in ("static_argnums", "static_argnames"):
+                    continue
+                self._check_static_value(kw.value, kw.arg)
+
+    def _check_static_value(self, val, kwname):
+        want = (int,) if kwname == "static_argnums" else (str,)
+        if isinstance(val, (ast.Dict, ast.Set)):
+            self.report(val, "GL-STATIC-ARGS",
+                        f"{kwname} must be an int/str or tuple thereof, "
+                        f"got a {type(val).__name__.lower()} literal")
+            return
+        if isinstance(val, ast.Call):
+            root, attr = _call_root_and_attr(val.func)
+            if (root in self.aliases["numpy"] or root in self.aliases["jnp"]
+                    or attr in ("array", "asarray", "arange")):
+                self.report(val, "GL-STATIC-ARGS",
+                            f"array-valued {kwname}: arrays are unhashable "
+                            "and poison the jit cache")
+            return
+        elts = val.elts if isinstance(val, (ast.Tuple, ast.List)) else [val]
+        for e in elts:
+            if isinstance(e, ast.Constant):
+                if not isinstance(e.value, want) or isinstance(e.value, bool):
+                    self.report(e, "GL-STATIC-ARGS",
+                                f"{kwname} element {e.value!r} is not "
+                                f"{'an int' if want == (int,) else 'a str'}")
+            elif isinstance(e, (ast.Dict, ast.Set, ast.ListComp)):
+                self.report(e, "GL-STATIC-ARGS",
+                            f"unhashable {kwname} element")
+
+    def _in_kernel_dir(self):
+        parts = self.relpath.replace(os.sep, "/").split("/")
+        return any(d in parts for d in self.cfg.kernel_dirs)
+
+    def _check_f64_literals(self, traced_infos):
+        if not self._in_kernel_dir():
+            return
+        # only flagged inside traced functions, and only outside
+        # dtype-conditional expressions (IfExp / Compare): a conditional
+        # widen like `c128 if x64 else c64` is the sanctioned pattern
+        for fi in traced_infos:
+            guarded = set()
+            for n in ast.walk(fi.node):
+                if isinstance(n, (ast.IfExp, ast.Compare)):
+                    for sub in ast.walk(n):
+                        guarded.add(id(sub))
+            for n in ast.walk(fi.node):
+                if id(n) in guarded:
+                    continue
+                name = None
+                if isinstance(n, ast.Attribute) and n.attr in _WIDE_DTYPES:
+                    name = n.attr
+                elif isinstance(n, ast.Constant) and n.value in _WIDE_DTYPES:
+                    name = n.value
+                if name:
+                    self.report(n, "GL-F64-LITERAL",
+                                f"dtype-widening literal {name!r} inside "
+                                f"traced kernel {fi.qualname!r}; derive the "
+                                "dtype from the inputs or gate on x64")
+
+    # ---- driver ----
+
+    def run(self, modname=""):
+        infos = _index_functions(self.tree)
+        by_scope = _name_scope_map(infos)
+        _seed_traced(self.tree, infos, by_scope, self.aliases,
+                     self.traced_lines, set(self.cfg.extra_trace_roots),
+                     modname)
+        _close_over_calls(infos, by_scope)
+
+        # taint-aware per-function rules; nested traced functions inherit
+        # the enclosing traced scope's taint (closure capture)
+        taint_out: dict = {}
+
+        def check(fi):
+            inherited = ()
+            p = fi.parent
+            while p is not None:
+                if id(p) in taint_out:
+                    inherited = taint_out[id(p)]
+                    break
+                p = p.parent
+            checker = _TracedFunctionChecker(self, fi, inherited)
+            taint_out[id(fi)] = checker.run()
+
+        # parents before children so closures inherit taint
+        def depth(fi):
+            d, p = 0, fi.parent
+            while p is not None:
+                d, p = d + 1, p.parent
+            return d
+
+        traced = [fi for fi in infos.values() if fi.traced]
+        for fi in sorted(traced, key=depth):
+            check(fi)
+
+        self._check_bare_except()
+        self._check_static_args()
+        self._check_f64_literals(traced)
+        return self.violations
+
+
+# ---------------------------------------------------------------------------
+# public entry points
+# ---------------------------------------------------------------------------
+
+
+def lint_source(source, path="<string>", cfg=None, relpath=None, modname=""):
+    """Lint one source string; returns a list of :class:`Violation`."""
+    cfg = cfg or Config()
+    return _FileLinter(path, source, cfg, relpath=relpath).run(modname)
+
+
+def lint_paths(paths, cfg=None, root=None):
+    """Lint every .py file under ``paths``; returns violations sorted by
+    location."""
+    cfg = cfg or Config()
+    root = root or os.getcwd()
+    files = []
+    for p in paths:
+        if os.path.isdir(p):
+            for dirpath, dirnames, filenames in os.walk(p):
+                dirnames[:] = [d for d in dirnames
+                               if d not in ("__pycache__", ".git")]
+                files.extend(os.path.join(dirpath, f)
+                             for f in filenames if f.endswith(".py"))
+        elif p.endswith(".py"):
+            files.append(p)
+    out = []
+    for f in sorted(files):
+        rel = os.path.relpath(f, root)
+        mod = rel[:-3].replace(os.sep, ".")
+        with open(f, encoding="utf-8") as fh:
+            src = fh.read()
+        try:
+            out.extend(lint_source(src, path=f, cfg=cfg, relpath=rel,
+                                   modname=mod))
+        except SyntaxError as e:
+            out.append(Violation(rel, e.lineno or 0, 0, "GL-SYNTAX",
+                                 f"could not parse: {e.msg}"))
+    out.sort(key=lambda v: (v.path, v.line, v.col))
+    return out
+
+
+def _baseline_counts(violations):
+    counts: dict = {}
+    for v in violations:
+        key = f"{v.path.replace(os.sep, '/')}:{v.rule}"
+        counts[key] = counts.get(key, 0) + 1
+    return counts
+
+
+def write_config(path, cfg, baseline_counts):
+    """Rewrite graftlint.toml preserving [lint]/[sentinel], replacing
+    [baseline]."""
+    lines = ["# graftlint configuration + ratchet baseline.",
+             "# The [baseline] counts may only go DOWN: fix a violation, then",
+             "# run `python -m raft_tpu.analysis.graftlint raft_tpu/ "
+             "--update-baseline`.",
+             "",
+             "[lint]",
+             f"kernel_dirs = {list(cfg.kernel_dirs)!r}".replace("'", '"'),
+             f"extra_trace_roots = {list(cfg.extra_trace_roots)!r}".replace(
+                 "'", '"'),
+             ""]
+    if cfg.sentinel:
+        lines.append("[sentinel]")
+        for k, v in sorted(cfg.sentinel.items()):
+            lines.append(f"{k} = {v!r}".replace("'", '"'))
+        lines.append("")
+    lines.append("[baseline]")
+    for key in sorted(baseline_counts):
+        lines.append(f'"{key}" = {baseline_counts[key]}')
+    lines.append("")
+    with open(path, "w", encoding="utf-8") as f:
+        f.write("\n".join(lines))
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="graftlint",
+        description="JAX/TPU trace-discipline linter for raft-tpu")
+    ap.add_argument("paths", nargs="*", default=["raft_tpu"])
+    ap.add_argument("--config", default=None,
+                    help="graftlint.toml (default: ./graftlint.toml if "
+                         "present)")
+    ap.add_argument("--update-baseline", action="store_true",
+                    help="rewrite the [baseline] table from the current "
+                         "violations (ratchet down after fixes)")
+    ap.add_argument("--no-baseline", action="store_true",
+                    help="ignore the baseline: report every violation")
+    ap.add_argument("-q", "--quiet", action="store_true")
+    args = ap.parse_args(argv)
+
+    root = os.getcwd()
+    cfg_path = args.config
+    if cfg_path is None and os.path.exists(os.path.join(root, "graftlint.toml")):
+        cfg_path = os.path.join(root, "graftlint.toml")
+    cfg = load_config(cfg_path)
+
+    paths = args.paths or ["raft_tpu"]
+    violations = lint_paths(paths, cfg=cfg, root=root)
+    counts = _baseline_counts(violations)
+
+    if args.update_baseline:
+        target = cfg_path or os.path.join(root, "graftlint.toml")
+        write_config(target, cfg, counts)
+        print(f"graftlint: baseline updated ({sum(counts.values())} "
+              f"suppressed violation(s)) -> {target}")
+        return 0
+
+    baseline = {} if args.no_baseline else cfg.baseline
+    over = []
+    loosened = []
+    for key in sorted(set(counts) | set(baseline)):
+        cur, base = counts.get(key, 0), int(baseline.get(key, 0))
+        if cur > base:
+            over.append((key, cur, base))
+        elif cur < base:
+            loosened.append((key, cur, base))
+
+    failed = bool(over)
+    if failed or not args.quiet:
+        shown = 0
+        over_keys = {k for k, _, _ in over}
+        for v in violations:
+            key = f"{v.path.replace(os.sep, '/')}:{v.rule}"
+            if key in over_keys or args.no_baseline:
+                print(v)
+                shown += 1
+        for key, cur, base in over:
+            print(f"graftlint: {key}: {cur} violation(s) > baseline {base}")
+    if loosened and not args.quiet:
+        for key, cur, base in loosened:
+            print(f"graftlint: {key}: {cur} < baseline {base} — run "
+                  "--update-baseline to ratchet down")
+    if not args.quiet:
+        n_files = len({v.path for v in violations})
+        status = "FAIL" if failed else "ok"
+        print(f"graftlint: {status} — {len(violations)} baselined/total "
+              f"violation(s) across {n_files} file(s); "
+              f"{sum(c for _, c, b in over)} over baseline")
+    return 1 if failed else 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,137 @@
+"""pytest integration for graftlint: lint gate + recompile sentinel.
+
+Loaded from the repo-root ``conftest.py`` via
+``pytest_plugins = ["raft_tpu.analysis.pytest_plugin"]``.  Provides:
+
+* ``--graftlint`` — run the AST linter over ``raft_tpu/`` as a session
+  check (fails the run if any violation exceeds the ``graftlint.toml``
+  baseline — same gate as the CLI).
+* ``--recompile-sentinel`` — count XLA compiles across the whole
+  session and enforce the per-suite budget from ``graftlint.toml``
+  ``[sentinel] suite_budget``.
+* ``@pytest.mark.compile_budget(n)`` — per-test ceiling on XLA backend
+  compiles (always enforced; marks deterministic compile-count tests).
+* ``sentinel`` fixture — a fresh :class:`RecompileSentinel` wrapping
+  the test body, for fine-grained "second call must not compile"
+  assertions.
+"""
+
+from __future__ import annotations
+
+import os
+
+import pytest
+
+
+def _repo_root():
+    return os.path.dirname(os.path.dirname(os.path.dirname(
+        os.path.abspath(__file__))))
+
+
+def _load_cfg():
+    from .graftlint import load_config
+
+    return load_config(os.path.join(_repo_root(), "graftlint.toml"))
+
+
+def pytest_addoption(parser):
+    group = parser.getgroup("graftlint")
+    group.addoption("--graftlint", action="store_true", default=False,
+                    help="lint raft_tpu/ against the graftlint.toml "
+                         "baseline and fail the session on regressions")
+    group.addoption("--recompile-sentinel", action="store_true",
+                    default=False,
+                    help="count XLA compiles across the session and "
+                         "enforce [sentinel] suite_budget from "
+                         "graftlint.toml")
+
+
+def pytest_configure(config):
+    config.addinivalue_line(
+        "markers",
+        "compile_budget(n): fail the test if it triggers more than n XLA "
+        "backend compiles (graftlint recompile sentinel)")
+    config.addinivalue_line(
+        "markers", "sentinel: deterministic compile-count tests (run in "
+                   "the CI lint job)")
+    if config.getoption("--recompile-sentinel"):
+        from .recompile import RecompileSentinel
+
+        s = RecompileSentinel()
+        s.__enter__()
+        config._graftlint_session_sentinel = s
+
+
+@pytest.fixture
+def sentinel():
+    """A RecompileSentinel active for the duration of the test body."""
+    from .recompile import RecompileSentinel
+
+    with RecompileSentinel() as s:
+        yield s
+
+
+@pytest.hookimpl(hookwrapper=True)
+def pytest_runtest_call(item):
+    marker = item.get_closest_marker("compile_budget")
+    if marker is None:
+        yield
+        return
+    budget = int(marker.args[0]) if marker.args else 0
+    from .recompile import RecompileSentinel
+
+    with RecompileSentinel() as s:
+        outcome = yield
+    if outcome.excinfo is None and s.backend_compiles > budget:
+        top = ", ".join(f"{k} x{v}" for k, v in
+                        s.compiles_by_name.most_common(10))
+        pytest.fail(
+            f"{item.nodeid} triggered {s.backend_compiles} XLA compiles "
+            f"> compile_budget({budget}) (top: {top})", pytrace=False)
+
+
+def pytest_sessionfinish(session, exitstatus):
+    config = session.config
+    lines = config._graftlint_summary = []
+
+    s = getattr(config, "_graftlint_session_sentinel", None)
+    if s is not None:
+        s.__exit__(None, None, None)
+        cfg = _load_cfg()
+        budget = int(cfg.sentinel.get("suite_budget", 0))
+        lines.append((
+            f"graftlint sentinel: {s.backend_compiles} XLA compiles, "
+            f"{s.jaxpr_traces} jaxpr traces this session"
+            + (f" (budget {budget})" if budget else ""), False))
+        if budget and s.backend_compiles > budget:
+            top = ", ".join(f"{k} x{v}" for k, v in
+                            s.compiles_by_name.most_common(10))
+            lines.append((f"graftlint sentinel: OVER BUDGET "
+                          f"(top compilers: {top})", True))
+            session.exitstatus = 1
+
+    if config.getoption("--graftlint"):
+        from .graftlint import _baseline_counts, lint_paths
+
+        root = _repo_root()
+        cfg = _load_cfg()
+        violations = lint_paths([os.path.join(root, "raft_tpu")], cfg=cfg,
+                                root=root)
+        counts = _baseline_counts(violations)
+        over = [(k, c, int(cfg.baseline.get(k, 0)))
+                for k, c in sorted(counts.items())
+                if c > int(cfg.baseline.get(k, 0))]
+        if over:
+            for key, cur, base in over:
+                lines.append((f"graftlint: {key}: {cur} violation(s) > "
+                              f"baseline {base}", True))
+            lines.append(("graftlint: FAIL", True))
+            session.exitstatus = 1
+        else:
+            lines.append((f"graftlint: ok ({len(violations)} baselined "
+                          "violation(s))", False))
+
+
+def pytest_terminal_summary(terminalreporter, exitstatus, config):
+    for line, is_error in getattr(config, "_graftlint_summary", []):
+        terminalreporter.write_line(line, red=is_error)

@@ -1,0 +1,156 @@
+"""Recompilation sentinel: count XLA compiles and attribute them.
+
+JAX recompiles silently — a cache-key change (new closure identity, a
+weak-type flip, an unhashable static arg, a fresh wrapper from a
+factory) turns a supposedly-warm call into seconds of XLA time.  This
+module counts compiles two ways:
+
+* ``jax.monitoring`` duration events (``/jax/core/compile/...``) give a
+  robust total of backend compiles and jaxpr traces;
+* the DEBUG-level per-compile log lines from ``jax._src`` carry the
+  function name, so repeats of the *same* function can be flagged.
+
+Typical use (also wired into pytest via
+:mod:`raft_tpu.analysis.pytest_plugin`)::
+
+    with RecompileSentinel() as s:
+        f(x)
+        n = s.backend_compiles
+        f(x)                      # same shapes: must hit the jit cache
+    assert s.backend_compiles == n
+
+The listener registration is process-global in jax; the sentinel keeps
+its callbacks registered but inert outside the ``with`` block (jax has
+no public unregister), so nesting and reuse are safe.
+"""
+
+from __future__ import annotations
+
+import logging
+import re
+from collections import Counter
+
+BACKEND_COMPILE_EVENT = "/jax/core/compile/backend_compile_duration"
+JAXPR_TRACE_EVENT = "/jax/core/compile/jaxpr_trace_duration"
+
+# loggers that emit "Finished XLA compilation of {fun_name} in ..." /
+# "Finished tracing + transforming {fun_name} ..." via
+# dispatch.log_elapsed_time
+_COMPILE_LOGGERS = ("jax._src.interpreters.pxla", "jax._src.dispatch",
+                    "jax._src.pjit")
+_COMPILE_RE = re.compile(r"Finished XLA compilation of ([^\s]+) in")
+_TRACE_RE = re.compile(r"Finished tracing \+ transforming ([^\s]+) ")
+
+
+class _LogCounter(logging.Handler):
+    def __init__(self, sentinel):
+        super().__init__(level=logging.DEBUG)
+        self.sentinel = sentinel
+
+    def emit(self, record):
+        try:
+            msg = record.getMessage()
+        except Exception:  # pragma: no cover - malformed record
+            return
+        m = _COMPILE_RE.search(msg)
+        if m:
+            self.sentinel.compiles_by_name[m.group(1)] += 1
+        m = _TRACE_RE.search(msg)
+        if m:
+            self.sentinel.traces_by_name[m.group(1)] += 1
+
+
+class RecompileSentinel:
+    """Context manager counting XLA compiles while active.
+
+    Attributes (valid inside and after the ``with`` block):
+
+    ``backend_compiles``
+        total XLA backend compiles (monitoring events; robust).
+    ``jaxpr_traces``
+        total jaxpr traces (a retrace without a compile still costs
+        host time and signals cache-key churn).
+    ``compiles_by_name`` / ``traces_by_name``
+        ``Counter`` keyed by the jit'd function name (log-derived).
+    """
+
+    _registered = False  # process-global: jax listeners cannot unregister
+    _active: list = []   # stack of live sentinels receiving events
+
+    def __init__(self):
+        self.backend_compiles = 0
+        self.jaxpr_traces = 0
+        self.compiles_by_name: Counter = Counter()
+        self.traces_by_name: Counter = Counter()
+        self._handler = None
+        self._old_levels = {}
+
+    # -- monitoring plumbing (class-level fanout to active sentinels) --
+
+    @classmethod
+    def _ensure_registered(cls):
+        if cls._registered:
+            return
+        import jax.monitoring
+
+        def on_duration(event, duration, **kw):
+            for s in cls._active:
+                if event == BACKEND_COMPILE_EVENT:
+                    s.backend_compiles += 1
+                elif event == JAXPR_TRACE_EVENT:
+                    s.jaxpr_traces += 1
+
+        jax.monitoring.register_event_duration_secs_listener(on_duration)
+        cls._registered = True
+
+    def __enter__(self):
+        self._ensure_registered()
+        RecompileSentinel._active.append(self)
+        self._handler = _LogCounter(self)
+        for name in _COMPILE_LOGGERS:
+            logger = logging.getLogger(name)
+            self._old_levels[name] = logger.level
+            # per-compile lines log at DEBUG unless jax_log_compiles; the
+            # handler needs the logger to pass DEBUG records through
+            if logger.level == 0 or logger.level > logging.DEBUG:
+                logger.setLevel(logging.DEBUG)
+            logger.addHandler(self._handler)
+        return self
+
+    def __exit__(self, *exc):
+        RecompileSentinel._active.remove(self)
+        for name in _COMPILE_LOGGERS:
+            logger = logging.getLogger(name)
+            logger.removeHandler(self._handler)
+            logger.setLevel(self._old_levels.get(name, 0))
+        return False
+
+    # -- assertions --
+
+    def snapshot(self):
+        """(backend_compiles, jaxpr_traces) pair for delta checks."""
+        return (self.backend_compiles, self.jaxpr_traces)
+
+    def compiles_since(self, snap):
+        return self.backend_compiles - snap[0]
+
+    def assert_no_recompile(self, snap, what="call"):
+        """Fail if any backend compile happened since ``snap`` — the
+        'unexpected second compile of the same function' gate."""
+        n = self.compiles_since(snap)
+        if n:
+            names = ", ".join(f"{k} x{v}" for k, v in
+                              self.compiles_by_name.most_common(8)) or "?"
+            raise AssertionError(
+                f"{what} triggered {n} unexpected XLA recompile(s) "
+                f"(compiled so far: {names}); a warm call must hit the "
+                "jit cache — check for closure/static-arg cache-key churn")
+
+    def assert_budget(self, budget, what="suite"):
+        if self.backend_compiles > budget:
+            top = ", ".join(f"{k} x{v}" for k, v in
+                            self.compiles_by_name.most_common(10))
+            raise AssertionError(
+                f"{what} used {self.backend_compiles} XLA compiles > "
+                f"budget {budget} (top: {top}); raise the budget in "
+                "graftlint.toml [sentinel] only with a reason")

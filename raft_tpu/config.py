@@ -14,7 +14,7 @@ RHO_AIR = 1.225
 GRAVITY = 9.81
 
 
-def enable_compilation_cache(path: str | None = None) -> str:
+def enable_compilation_cache(path: str | None = None) -> str | None:
     """Turn on JAX's persistent (on-disk) compilation cache.
 
     The end-to-end sweep is compile-dominated in a cold process (~56 s of
@@ -38,6 +38,15 @@ def enable_compilation_cache(path: str | None = None) -> str:
     import os
 
     if jax.default_backend() == "cpu":
+        if path is not None:
+            # an explicit path is a stated intent; don't drop it silently
+            import warnings
+
+            warnings.warn(
+                f"enable_compilation_cache({path!r}): persistent cache "
+                "disabled on the CPU backend (XLA:CPU AOT entries embed "
+                "compile-host CPU features and fail to reload; see "
+                "docstring)", RuntimeWarning, stacklevel=2)
         return None
     if path is None:
         path = os.environ.get("RAFT_TPU_CACHE_DIR")
@@ -45,14 +54,15 @@ def enable_compilation_cache(path: str | None = None) -> str:
         path = os.path.join(os.path.dirname(os.path.dirname(__file__)), ".jax_cache")
     os.makedirs(path, exist_ok=True)
     jax.config.update("jax_compilation_cache_dir", path)
-    # floor at 6 s of compile time: that admits the two big sweep-chunk
-    # executables (partA ~15 s, partB ~7 s on TPU, the only entries worth
-    # persisting since the round-5 split-AOT design) while keeping the
-    # mixed CPU-backend helper programs of the same process out of the
-    # cache (largest: _eval_and_jac ~4 s) — a CPU AOT entry would only
-    # spam the loader on the next run (see above) since its
-    # machine-feature check rejects it even same-host
-    jax.config.update("jax_persistent_cache_min_compile_time_secs", 6)
+    # floor at 2 s of compile time: admits the big sweep-chunk
+    # executables (partA ~15 s, partB ~7 s on TPU) plus the mid-size
+    # solver programs (case_solve ~2-4 s) whose recompiles still dominate
+    # a warm second process.  CPU-backend helper programs never reach
+    # this config — the function returns above on the cpu backend — so
+    # the old 6 s guard against CPU AOT loader spam is no longer what
+    # this floor is for; sub-2 s entries stay out simply because
+    # deserializing them costs about as much as recompiling.
+    jax.config.update("jax_persistent_cache_min_compile_time_secs", 2)
     jax.config.update("jax_persistent_cache_min_entry_size_bytes", 0)
     return path
 

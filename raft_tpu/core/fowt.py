@@ -126,7 +126,7 @@ def _member_wave_kinematics(pose, zeta, beta, w, k, depth, rho, g):
     return u, ud, pDyn
 
 
-def _member_inertial_excitation(topo, pose, hydro, ud, pDyn, prp):
+def _member_inertial_excitation(topo, pose, hydro, ud, pDyn, prp):  # graftlint: static=topo
     """Froude-Krylov + added-mass inertial excitation rollup for one member.
 
     Vectorizes the node loop at raft_fowt.py:1098-1124.  ``ud`` is

@@ -171,7 +171,7 @@ _N_TAIL_PANELS = {1: 512, 2: 512}
 _JNP_CHUNK = 24576
 
 
-def _pv_fd_jnp_impl(R, s, K, h, k, kind):
+def _pv_fd_jnp_impl(R, s, K, h, k, kind):  # graftlint: static=kind
     """Vectorized PV quadrature for one chunk of points (same rules as
     the scalar paths, but with a per-point adaptive tail of FIXED panel
     count so the whole chunk is one static-shape XLA program)."""

@@ -222,7 +222,7 @@ def compile_mooring(mooring: dict, x_ref: float = 0.0, y_ref: float = 0.0,
 # ---------------------------------------------------------------------------
 
 
-def point_positions(ms: CompiledMooring, params: MooringParams, r6, free_xyz=None):
+def point_positions(ms: CompiledMooring, params: MooringParams, r6, free_xyz=None):  # graftlint: static=ms
     """World positions of every point for body pose(s) ``r6``.
 
     ``r6`` is [6] (single body) or [nB,6].  Coupled points ride their
@@ -396,14 +396,14 @@ def _solve_free_points_jvp(ms, primals, tangents):
     return x, x_dot
 
 
-def _equilibrium_positions(ms: CompiledMooring, params: MooringParams, r6):
+def _equilibrium_positions(ms: CompiledMooring, params: MooringParams, r6):  # graftlint: static=ms
     if ms.has_free:
         x = _solve_free_points(ms, params, r6)
         return point_positions(ms, params, r6, free_xyz=x.reshape(-1, 3))
     return point_positions(ms, params, r6)
 
 
-def _bodies_forces(ms: CompiledMooring, params: MooringParams, r6s):
+def _bodies_forces(ms: CompiledMooring, params: MooringParams, r6s):  # graftlint: static=ms
     """Net 6-DOF line force/moment on every coupled body. r6s [nB,6] -> [nB,6]."""
     r6s = jnp.atleast_2d(jnp.asarray(r6s))
     pos = _equilibrium_positions(ms, params, r6s)
@@ -705,7 +705,7 @@ def compile_moordyn_file(path: str, depth: float, body_coords=None,
     )
 
 
-def fairlead_forces(ms: CompiledMooring, params: MooringParams, r6):
+def fairlead_forces(ms: CompiledMooring, params: MooringParams, r6):  # graftlint: static=ms
     """Force magnitude at each body-attached (vessel) point — the
     'fairlead tensions' mean output (raft_model.py:822)."""
     pos = _equilibrium_positions(ms, params, jnp.asarray(r6))

@@ -13,7 +13,10 @@ from __future__ import annotations
 
 import jax.numpy as jnp
 
+from ..analysis.contracts import shape_contract
 
+
+@shape_contract("[*,3]->[*,3,3]")
 def rotation_matrix(rpy):
     """Intrinsic z-y-x (yaw-pitch-roll applied to rotated axes) DCM.
 
@@ -32,6 +35,7 @@ def rotation_matrix(rpy):
     return jnp.stack([row0, row1, row2], axis=-2)
 
 
+@shape_contract("[*,3],[*,3]->[*,3]")
 def small_rotate(r, th):
     """First-order displacement of point ``r`` under small rotations ``th``.
 
@@ -46,12 +50,14 @@ def small_rotate(r, th):
     return jnp.stack([x, y, z], axis=-1)
 
 
+@shape_contract("[*,3]->[*,3,3]")
 def outer3(vec):
     """vec · vecᵀ for ``[..., 3]`` vectors (helpers.VecVecTrans)."""
     vec = jnp.asarray(vec)
     return vec[..., :, None] * vec[..., None, :]
 
 
+@shape_contract("[*,3]->[*,3,3]")
 def alternator(r):
     """Alternator (cross-product) matrix H of a size-3 vector (helpers.getH).
 
@@ -67,6 +73,7 @@ def alternator(r):
     return jnp.stack([row0, row1, row2], axis=-2)
 
 
+@shape_contract("[*,3],[*,3]->[*,6]")
 def translate_force_3to6(F, r):
     """Force at point ``r`` → 6-DOF force/moment about origin.
 
@@ -78,6 +85,7 @@ def translate_force_3to6(F, r):
     return jnp.concatenate([F, jnp.cross(r, F)], axis=-1)
 
 
+@shape_contract("[*,3,3],[*,3]->[*,6,6]")
 def translate_matrix_3to6(M, r):
     """3x3 mass-like matrix at point ``r`` → 6x6 about origin.
 
@@ -91,6 +99,7 @@ def translate_matrix_3to6(M, r):
     return jnp.concatenate([top, bottom], axis=-2)
 
 
+@shape_contract("[*,6,6],[*,3]->[*,6,6]")
 def translate_matrix_6to6(M, r):
     """Translate a 6x6 mass/inertia matrix to a new reference point.
 
@@ -111,11 +120,13 @@ def translate_matrix_6to6(M, r):
     return jnp.concatenate([top, bottom], axis=-2)
 
 
+@shape_contract("[*,3,3],[*,3,3]->[*,3,3]")
 def rotate_matrix3(M, R):
     """[m'] = [R][m][R]^T (helpers.rotateMatrix3)."""
     return R @ M @ jnp.swapaxes(R, -1, -2)
 
 
+@shape_contract("[*,6,6],[*,3,3]->[*,6,6]")
 def rotate_matrix6(M, R):
     """Rotate a 6x6 tensor by DCM ``R`` blockwise (helpers.rotateMatrix6)."""
     m = rotate_matrix3(M[..., :3, :3], R)
@@ -143,6 +154,7 @@ def rot_from_vectors(A, B, eps=0.0):
     return jnp.where((v2 == 0)[..., None, None], eye, R)
 
 
+@shape_contract("[*,3],[*,6]->[*,3]")
 def transform_position(r_rel, r6):
     """Position of a body-fixed point after body displacement ``r6``.
 

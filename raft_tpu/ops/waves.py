@@ -15,9 +15,11 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.contracts import shape_contract
 from ..config import GRAVITY, RHO_WATER
 
 
+@shape_contract("[nw],_->[nw]")
 def wave_number(w, depth, tol=1e-3, max_iter=10_000):
     """Dispersion relation solve: k such that w² = g·k·tanh(k·h).
 
@@ -55,6 +57,7 @@ def wave_number(w, depth, tol=1e-3, max_iter=10_000):
 wave_number = jax.jit(wave_number, static_argnums=(2, 3), static_argnames=("tol", "max_iter"))
 
 
+@shape_contract("[nw],_,[nw],[nw],_,[*,3]->[*,3,nw],[*,3,nw],[*,nw]")
 def wave_kinematics(zeta0, beta, w, k, depth, r, rho=RHO_WATER, g=GRAVITY):
     """First-order wave velocity/acceleration/dynamic-pressure amplitudes.
 
@@ -112,6 +115,7 @@ def wave_kinematics(zeta0, beta, w, k, depth, r, rho=RHO_WATER, g=GRAVITY):
     return u, ud, pDyn
 
 
+@shape_contract("[*,3],[6,nw],[nw]->[*,3,nw],[*,3,nw],[*,3,nw]")
 def kinematics_from_modes(r, Xi, w):
     """Node displacement/velocity/acceleration from 6-DOF motion amplitudes.
 
@@ -137,6 +141,7 @@ def kinematics_from_modes(r, Xi, w):
     return dr, v, a
 
 
+@shape_contract("[nw],_,_->[nw]")
 def jonswap(ws, Hs, Tp, gamma=None):
     """One-sided JONSWAP spectrum [m²/(rad/s)] (helpers.JONSWAP).
 
@@ -167,6 +172,7 @@ def jonswap(ws, Hs, Tp, gamma=None):
     return 0.5 / jnp.pi * C * 0.3125 * Hs * Hs * fpOvrf4 / f * jnp.exp(-1.25 * fpOvrf4) * Gamma**Alpha
 
 
+@shape_contract("[*,nw],_->[*,nw]")
 def spectrum_to_amplitude(S, dw):
     """Wave elevation amplitude per bin from a PSD: sqrt(2 S dw)."""
     return jnp.sqrt(2.0 * jnp.asarray(S) * dw)
@@ -191,6 +197,7 @@ def psd(xi, dw):
     return out
 
 
+@shape_contract("[*,nw],[*,nw]->[*,nw]")
 def rao(Xi, zeta, eps=1e-6):
     """Response amplitude operator Xi/zeta with a dead-band on tiny waves
     (helpers.getRAO)."""

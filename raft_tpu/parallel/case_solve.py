@@ -147,9 +147,11 @@ def make_parametric_solver(static, n_iter=15):
     XiStart = 0.1
     drag_coef = np.sqrt(8.0 / np.pi) * 0.5 * rho
 
-    from ..ops import waves as waves_ops
+    from ..analysis.contracts import shape_contract
     from ..ops import transforms
+    from ..ops import waves as waves_ops
 
+    @shape_contract("_,[nH,nw],[nH]->[nH,6,nw]")
     def solve(params, zeta, beta, aero=None):
         nodes = params["nodes"]
         w = params["w"]

@@ -40,6 +40,7 @@ import jax
 import jax.numpy as jnp
 
 from ..mooring import system as moorsys
+from ..analysis.contracts import shape_contract
 from ..ops import transforms
 from ..structure import member as mstruct
 
@@ -275,6 +276,14 @@ def rna_params_for(fowt):
     return jax.tree_util.tree_map(jnp.asarray, rna)
 
 
+@shape_contract("[6,6],[3,3],[3]->[6,6]")
+def _rna_mass_about_prp(Mdiag, R_q, r_CG_rel):
+    """One RNA's 6x6 mass matrix rotated into the platform frame and
+    translated to the PRP (raft_fowt.py:467-480)."""
+    Mmat = transforms.rotate_matrix6(Mdiag, R_q)
+    return transforms.translate_matrix_6to6(Mmat, r_CG_rel)
+
+
 def make_batch_compiler(fowt):
     """Build ``compile_one(geoms, moor_params) -> params`` for vmapping
     over stacked design variants.
@@ -400,8 +409,8 @@ def make_batch_compiler(fowt):
 
         # RNA contributions (raft_fowt.py:467-480)
         for ir in range(rna["mRNA"].shape[0]):
-            Mmat = transforms.rotate_matrix6(rna["Mdiag"][ir], rna["R_q"][ir])
-            M_struc = M_struc + transforms.translate_matrix_6to6(Mmat, rna["r_CG_rel"][ir])
+            M_struc = M_struc + _rna_mass_about_prp(
+                rna["Mdiag"][ir], rna["R_q"][ir], rna["r_CG_rel"][ir])
             m_center_sum = m_center_sum + rna["r_CG_rel"][ir] * rna["mRNA"][ir]
 
         m_all = M_struc[0, 0]

@@ -30,8 +30,10 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from ..analysis.contracts import shape_contract
 
-def _gauss_jordan_rows(rows_r, rows_i, n):
+
+def _gauss_jordan_rows(rows_r, rows_i, n):  # graftlint: static=n
     """Unrolled complex Gauss-Jordan with partial pivoting on row lists.
 
     rows_*: list of n arrays [ncol, B] (matrix columns then RHS columns).
@@ -82,6 +84,7 @@ def _gauss_jordan_rows(rows_r, rows_i, n):
     return rows_r, rows_i
 
 
+@shape_contract("[n,n,nw],[n,n,nw],[n,m,nw],[n,m,nw]->[n,m,nw],[n,m,nw]")
 def solve_batchlast_jnp(Zr, Zi, Fr, Fi):
     """Solve Z x = F for [n, n, B] matrices and [n, m, B] right sides.
 
@@ -160,6 +163,7 @@ def use_pallas() -> bool:
         return False
 
 
+@shape_contract("[nw,n,n],[n,nw]->[n,nw]")
 def solve_impedance(Z, F):
     """Complex convenience wrapper: Z [nw, n, n], F [n, nw] -> Xi [n, nw].
 
@@ -178,6 +182,7 @@ def solve_impedance(Z, F):
     return xr[:, 0, :] + 1j * xi[:, 0, :]
 
 
+@shape_contract("[nw,n,n],[nH,n,nw]->[nH,n,nw]")
 def solve_impedance_multi(Z, F_all):
     """Z [nw, n, n] complex, F_all [nH, n, nw] complex -> [nH, n, nw].
 
@@ -195,6 +200,7 @@ def solve_impedance_multi(Z, F_all):
     return jnp.transpose(xr + 1j * xi, (1, 0, 2))
 
 
+@shape_contract("[nw,n,n]->[nw,n,n]")
 def inverse_impedance(Z):
     """Batched inverse via Gauss-Jordan with the identity as RHS:
     Z [nw, n, n] complex -> Zinv [nw, n, n] complex."""

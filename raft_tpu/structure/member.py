@@ -329,7 +329,7 @@ def _safe_norm2(x, y):
     return jnp.where(s > 0, jnp.sqrt(jnp.where(s > 0, s, 1.0)), 0.0)
 
 
-def _discretize(topo: MemberTopology, geom: MemberGeometry):
+def _discretize(topo: MemberTopology, geom: MemberGeometry):  # graftlint: static=topo
     """Strip discretization with the reference's node layout
     (raft_member.py:169-216), node counts static from the topology.
     Builds one vectorized block per segment and concatenates (a handful of
@@ -501,7 +501,7 @@ def _segment_mass_props(topo: MemberTopology, geom: MemberGeometry):
     return mass, hc, m_shell, m_fill, v_fill, Ixx, Iyy, Izz
 
 
-def _cap_mass_props(topo: MemberTopology, geom: MemberGeometry):
+def _cap_mass_props(topo: MemberTopology, geom: MemberGeometry):  # graftlint: static=topo
     """Pose-independent cap/bulkhead masses and local MoIs
     (raft_member.py:553-671).  Branches are static via topo.cap_kinds."""
     masses, hcs, Ixxs, Iyys, Izzs, Ls, hs = [], [], [], [], [], [], []
@@ -593,7 +593,7 @@ def _cap_mass_props(topo: MemberTopology, geom: MemberGeometry):
     )
 
 
-def member_inertia(topo: MemberTopology, geom: MemberGeometry, pose: MemberPose, rPRP=None):
+def member_inertia(topo: MemberTopology, geom: MemberGeometry, pose: MemberPose, rPRP=None):  # graftlint: static=topo
     """Member mass/inertia rollup about the PRP in global directions.
 
     Returns (M_struc [6,6], mass, center [3], m_shell, m_fill [n_seg],
@@ -863,7 +863,7 @@ def node_volumes_areas(topo: MemberTopology, pose: MemberPose):
     }
 
 
-def member_hydro_constants(topo: MemberTopology, geom: MemberGeometry, pose: MemberPose,
+def member_hydro_constants(topo: MemberTopology, geom: MemberGeometry, pose: MemberPose,  # graftlint: static=topo
                            r_ref=None, rho=RHO_WATER, g=GRAVITY, k_array=None):
     """Strip-theory added-mass and inertial-excitation coefficients.
 

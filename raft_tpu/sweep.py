@@ -30,6 +30,7 @@ import jax
 import jax.numpy as jnp
 
 from . import profiling
+from .analysis.contracts import shape_contract
 from .core.model import Model
 from .ops import waves
 from .parallel.design_batch import SweepAxisError, set_in_design, stack_variants
@@ -498,6 +499,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             z_hubs = jnp.asarray([float(r.r3[2]) for r in fowt.rotorList] or [0.0])
             w_j = jnp.asarray(fowt.w)
 
+            @shape_contract("[c,h,1,6,nw],[c,r]->[c,h,6],[c,h]")
             def _metrics(Xi, zh):
                 """Xi [chunk, ncase, 1, 6, nw]; zh [chunk, nrot]."""
                 std = jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1))
@@ -592,6 +594,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             # zero-geometry solve just produces NaNs in dead buffers).
             lA = jA.lower(*argsA)
             built: dict = {}
+            warm_failures: dict = {}
 
             # warm-exec only pays when the main thread has aero/variant
             # table work to overlap it with; in 'plain' mode the join
@@ -604,10 +607,15 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                     compiled = lowered.compile()
                     built[key] = compiled
                     if warm_exec:
+                        # warm-exec is best-effort — the real chunk call
+                        # still works if the dummy run fails — but the
+                        # failure is recorded and surfaced after the join
+                        # (a broken warm run usually means every chunk
+                        # will pay the upload cost it was meant to hide)
                         try:
                             jax.block_until_ready(compiled(*dummy_args_fn()))
-                        except Exception:
-                            pass  # warm-exec is best-effort
+                        except Exception as e:
+                            warm_failures[key] = e
                 except Exception as e:  # pragma: no cover - best-effort
                     built[key] = e
 
@@ -696,6 +704,11 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 for t in threads:
                     t.join()
             cA, cB = built.get("A"), built.get("B")
+            if warm_failures and display:
+                for key, err in sorted(warm_failures.items()):
+                    print(f"sweep: warm-exec of part {key} failed "
+                          f"({type(err).__name__}: {err}); first chunk "
+                          "will pay executable initialization")
             if isinstance(cA, Exception) or isinstance(cB, Exception):
                 # AOT failed (e.g. an exotic sharding/backend combination):
                 # fall back to the plain jits, which compile inline at the

@@ -1,0 +1,599 @@
+"""Tests for raft_tpu.analysis: graftlint rules, shape contracts, and the
+recompilation sentinel.
+
+Each lint rule gets a positive (fires) and a negative (stays quiet) case;
+the negatives encode the precision features (taint stops at .shape,
+is-None tests, `# graftlint:` directives) that keep the linter usable on
+the real package.
+"""
+
+import textwrap
+
+import jax
+import jax.numpy as jnp
+import pytest
+
+from raft_tpu.analysis import (
+    RecompileSentinel,
+    ShapeContractError,
+    shape_contract,
+    verify_contract,
+)
+from raft_tpu.analysis.graftlint import lint_source
+
+
+def _rules(src, relpath="raft_tpu/ops/fake.py"):
+    src = textwrap.dedent(src)
+    return [v.rule for v in lint_source(src, relpath=relpath)]
+
+
+# ---------------------------------------------------------------------------
+# GL-NP-IN-JIT
+# ---------------------------------------------------------------------------
+
+
+def test_np_in_jit_fires():
+    rules = _rules("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.clip(x, 0, 1)
+        """)
+    assert "GL-NP-IN-JIT" in rules
+
+
+def test_np_on_host_constant_is_quiet():
+    # np on untainted (host-side) values is fine inside a traced fn
+    rules = _rules("""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            bound = np.log(np.finfo(np.float32).max)
+            return jnp.clip(x, -bound, bound)
+        """)
+    assert rules == []
+
+
+def test_np_shape_query_on_tracer_is_quiet():
+    # .shape/.ndim/len() of a tracer are static — not host syncs
+    rules = _rules("""
+        import jax
+        import jax.numpy as jnp
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            n = x.shape[0]
+            return jnp.zeros(np.maximum(n, 1)) + x
+        """)
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# GL-HOST-CAST
+# ---------------------------------------------------------------------------
+
+
+def test_host_cast_fires_on_float_and_item():
+    rules = _rules("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            a = float(x)
+            b = x.sum().item()
+            return a + b
+        """)
+    assert rules.count("GL-HOST-CAST") == 2
+
+
+def test_host_cast_on_untainted_is_quiet():
+    rules = _rules("""
+        import jax
+        import jax.numpy as jnp
+
+        SCALE = "1.5"
+
+        @jax.jit
+        def f(x):
+            return x * float(SCALE)
+        """)
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# GL-PY-BRANCH
+# ---------------------------------------------------------------------------
+
+
+def test_py_branch_fires_on_traced_if_and_while():
+    rules = _rules("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            if x > 0:
+                x = x * 2
+            while x < 10:
+                x = x + 1
+            return x
+        """)
+    assert rules.count("GL-PY-BRANCH") == 2
+
+
+def test_py_branch_quiet_on_none_shape_and_membership():
+    rules = _rules("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, opts, r6=None):
+            if r6 is None:
+                r6 = jnp.zeros(6)
+            if "gain" in opts:
+                x = x * opts["gain"]
+            if x.shape[0] > 3:
+                x = x[:3]
+            return x + r6[:3]
+        """)
+    assert rules == []
+
+
+def test_py_branch_respects_static_directive():
+    src = """
+        import jax
+
+        @jax.jit
+        def f(x, topo):  # graftlint: static=topo
+            if topo.flag:
+                return x * 2
+            return x
+        """
+    assert _rules(src) == []
+    assert "GL-PY-BRANCH" in _rules(src.replace("  # graftlint: static=topo", ""))
+
+
+# ---------------------------------------------------------------------------
+# GL-BARE-EXCEPT
+# ---------------------------------------------------------------------------
+
+
+def test_bare_except_fires():
+    rules = _rules("""
+        def f():
+            try:
+                risky()
+            except Exception:
+                pass
+        """)
+    assert "GL-BARE-EXCEPT" in rules
+
+
+def test_handled_except_is_quiet():
+    rules = _rules("""
+        def f(log):
+            try:
+                risky()
+            except Exception as e:
+                log.append(e)
+            try:
+                risky()
+            except ValueError:
+                pass
+        """)
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# GL-STATIC-ARGS
+# ---------------------------------------------------------------------------
+
+
+def test_static_args_fires_on_array_value():
+    rules = _rules("""
+        import jax
+        import numpy as np
+
+        def g(x, idx):
+            return x
+
+        h = jax.jit(g, static_argnums=np.array([1]))
+        """)
+    assert "GL-STATIC-ARGS" in rules
+
+
+def test_static_args_tuple_of_ints_is_quiet():
+    rules = _rules("""
+        import jax
+
+        def g(x, n, tol=1e-3):
+            return x * n
+
+        h = jax.jit(g, static_argnums=(1,), static_argnames=("tol",))
+        """)
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# GL-F64-LITERAL (kernel dirs only)
+# ---------------------------------------------------------------------------
+
+_F64_SRC = """
+    import jax
+    import jax.numpy as jnp
+
+    @jax.jit
+    def f(x):
+        return x.astype(jnp.float64)
+    """
+
+
+def test_f64_literal_fires_in_kernel_dir():
+    assert "GL-F64-LITERAL" in _rules(_F64_SRC, relpath="raft_tpu/ops/fake.py")
+
+
+def test_f64_literal_quiet_outside_kernel_dirs_and_when_gated():
+    # non-kernel module: the widening is someone else's policy decision
+    assert _rules(_F64_SRC, relpath="raft_tpu/core/fake.py") == []
+    # dtype-conditional widen is the sanctioned pattern even in kernels
+    rules = _rules("""
+        import jax
+        import jax.numpy as jnp
+
+        @jax.jit
+        def f(x, x64):
+            dt = jnp.complex128 if x64 else jnp.complex64
+            return x.astype(dt)
+        """, relpath="raft_tpu/ops/fake.py")
+    assert "GL-F64-LITERAL" not in rules
+
+
+# ---------------------------------------------------------------------------
+# GL-NESTED-JIT
+# ---------------------------------------------------------------------------
+
+
+def test_nested_jit_fires():
+    rules = _rules("""
+        import jax
+
+        @jax.jit
+        def f(x):
+            g = jax.jit(lambda y: y * 2)
+            return g(x)
+        """)
+    assert "GL-NESTED-JIT" in rules
+
+
+def test_module_level_jit_is_quiet():
+    rules = _rules("""
+        import jax
+
+        def f(x):
+            return x * 2
+
+        f = jax.jit(f)
+        """)
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
+# trace reachability + directives
+# ---------------------------------------------------------------------------
+
+
+def test_reachability_through_vmap_and_closure():
+    # helper isn't decorated, but it's called from a vmapped fn: traced
+    rules = _rules("""
+        import jax
+        import numpy as np
+
+        def helper(x):
+            return np.abs(x)
+
+        def outer(xs):
+            return jax.vmap(lambda x: helper(x) * 2)(xs)
+        """)
+    assert "GL-NP-IN-JIT" in rules
+
+
+def test_untraced_function_is_not_checked():
+    rules = _rules("""
+        import numpy as np
+
+        def host_only(x):
+            if x > 0:
+                return float(np.clip(x, 0, 1))
+            return 0.0
+        """)
+    assert rules == []
+
+
+def test_disable_directive_suppresses():
+    rules = _rules("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            return np.clip(x, 0, 1)  # graftlint: disable=GL-NP-IN-JIT
+        """)
+    assert rules == []
+
+
+def test_traced_directive_marks_root():
+    src = """
+        import numpy as np
+
+        def f(x):{mark}
+            return np.clip(x, 0, 1)
+        """
+    assert _rules(src.format(mark="")) == []
+    assert "GL-NP-IN-JIT" in _rules(src.format(mark="  # graftlint: traced"))
+
+
+def test_shape_contract_decorator_marks_root():
+    rules = _rules("""
+        import numpy as np
+        from raft_tpu.analysis.contracts import shape_contract
+
+        @shape_contract("[n]->[n]")
+        def f(x):
+            return np.clip(x, 0, 1)
+        """)
+    assert "GL-NP-IN-JIT" in rules
+
+
+def test_baseline_ratchet_counts():
+    from raft_tpu.analysis.graftlint import _baseline_counts
+
+    vs = lint_source(textwrap.dedent("""
+        import jax
+        import numpy as np
+
+        @jax.jit
+        def f(x):
+            y = np.clip(x, 0, 1)
+            return y + np.square(x)
+        """), relpath="raft_tpu/ops/fake.py")
+    counts = _baseline_counts(vs)
+    assert counts == {"raft_tpu/ops/fake.py:GL-NP-IN-JIT": 2}
+
+
+def test_repo_is_clean_against_baseline():
+    """The shipped tree must lint clean (CI gate parity)."""
+    import os
+
+    from raft_tpu.analysis.graftlint import _baseline_counts, lint_paths, load_config
+
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    cfg = load_config(os.path.join(root, "graftlint.toml"))
+    counts = _baseline_counts(
+        lint_paths([os.path.join(root, "raft_tpu")], cfg=cfg, root=root))
+    over = {k: (c, int(cfg.baseline.get(k, 0))) for k, c in counts.items()
+            if c > int(cfg.baseline.get(k, 0))}
+    assert not over, f"lint regressions vs graftlint.toml baseline: {over}"
+
+
+# ---------------------------------------------------------------------------
+# shape contracts
+# ---------------------------------------------------------------------------
+
+
+def test_contract_accepts_and_binds_dims():
+    @shape_contract("[N,6],[6,nw]->[N,nw]")
+    def apply(P, Xi):
+        return P @ Xi
+
+    out = apply(jnp.ones((4, 6)), jnp.ones((6, 10)))
+    assert out.shape == (4, 10)
+
+
+def test_contract_rejects_rank_and_literal_mismatch():
+    @shape_contract("[N,6]->[N]")
+    def rowsum(P):
+        return P.sum(axis=-1)
+
+    with pytest.raises(ShapeContractError, match="rank"):
+        rowsum(jnp.ones((4,)))
+    with pytest.raises(ShapeContractError, match="literal"):
+        rowsum(jnp.ones((4, 5)))
+
+
+def test_contract_rejects_inconsistent_dim_var():
+    @shape_contract("[n],[n]->[n]")
+    def add(a, b):
+        return a + b
+
+    with pytest.raises(ShapeContractError, match="rebinds"):
+        add(jnp.ones(3), jnp.ones(4))
+
+
+def test_contract_checks_outputs():
+    @shape_contract("[n]->[n]")
+    def bad(a):
+        return jnp.concatenate([a, a])  # violates its own declaration
+
+    with pytest.raises(ShapeContractError, match="output"):
+        bad(jnp.ones(3))
+
+
+def test_contract_skip_and_batch_dims():
+    @shape_contract("_,[*,3]->[*,3,3]")
+    def outer(params, v):
+        return v[..., :, None] * v[..., None, :]
+
+    assert outer({"any": "tree"}, jnp.ones((5, 2, 3))).shape == (5, 2, 3, 3)
+    assert outer(None, jnp.ones(3)).shape == (3, 3)
+
+
+def test_contract_works_under_jit_and_vmap():
+    @shape_contract("[n],[n]->[n]")
+    def add(a, b):
+        return a + b
+
+    jadd = jax.jit(add)
+    assert jadd(jnp.ones(4), jnp.ones(4)).shape == (4,)
+    with pytest.raises(ShapeContractError):
+        jax.jit(add)(jnp.ones((2, 4)), jnp.ones((2, 4)))
+    # under vmap the kernel sees unbatched shapes
+    assert jax.vmap(add)(jnp.ones((7, 4)), jnp.ones((7, 4))).shape == (7, 4)
+
+
+def test_contract_disable_env(monkeypatch):
+    @shape_contract("[3]->[3]")
+    def f(x):
+        return x
+
+    monkeypatch.setenv("RAFT_TPU_CONTRACTS", "0")
+    assert f(jnp.ones(5)).shape == (5,)  # contract inert
+    monkeypatch.setenv("RAFT_TPU_CONTRACTS", "1")
+    with pytest.raises(ShapeContractError):
+        f(jnp.ones(5))
+
+
+def test_verify_contract_eval_shape():
+    @shape_contract("[N,6],[6,nw]->[N,nw]")
+    def apply(P, Xi):
+        return P @ Xi
+
+    out = verify_contract(apply, jax.ShapeDtypeStruct((4, 6), jnp.float32),
+                          jax.ShapeDtypeStruct((6, 10), jnp.float32))
+    assert out.shape == (4, 10)
+    with pytest.raises(ShapeContractError):
+        verify_contract(apply, jax.ShapeDtypeStruct((4, 5), jnp.float32),
+                        jax.ShapeDtypeStruct((5, 10), jnp.float32))
+
+
+def test_live_kernels_carry_contracts():
+    """Acceptance: ≥10 shipped kernels are contract-decorated."""
+    from raft_tpu.ops import transforms, waves
+    from raft_tpu.parallel import smallsolve
+
+    mods = [transforms, waves, smallsolve]
+    decorated = [
+        getattr(m, name) for m in mods for name in dir(m)
+        if hasattr(getattr(m, name), "__shape_contract__")
+    ]
+    assert len(decorated) >= 10
+    # and one of them verifies statically against production-like shapes
+    from raft_tpu.ops.waves import kinematics_from_modes
+
+    out = verify_contract(
+        kinematics_from_modes,
+        jax.ShapeDtypeStruct((12, 3), jnp.float64),
+        jax.ShapeDtypeStruct((6, 40), jnp.complex128),
+        jax.ShapeDtypeStruct((40,), jnp.float64))
+    assert out[0].shape == (12, 3, 40)
+
+
+# ---------------------------------------------------------------------------
+# recompile sentinel
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.sentinel
+def test_sentinel_counts_compiles_and_cache_hits():
+    @jax.jit
+    def f(x):
+        return x * 2 + 1
+
+    x = jnp.arange(8.0)
+    # materialize the warm-call operand OUTSIDE the sentinel: the eager
+    # `x + 1` is itself a tiny jit program and would count as a compile
+    x1 = jax.block_until_ready(x + 1)
+    with RecompileSentinel() as s:
+        jax.block_until_ready(f(x))
+        assert s.backend_compiles >= 1
+        snap = s.snapshot()
+        jax.block_until_ready(f(x1))  # same shape/dtype: cache hit
+        s.assert_no_recompile(snap, "warm call")
+        # a new shape is a legitimate second compile
+        jax.block_until_ready(f(jnp.arange(16.0)))
+        assert s.compiles_since(snap) >= 1
+
+
+@pytest.mark.sentinel
+def test_sentinel_detects_cache_key_churn():
+    def make(scale):
+        # fresh closure identity per call — the classic recompile bug
+        return jax.jit(lambda x: x * scale)
+
+    x = jnp.arange(8.0)
+    with RecompileSentinel() as s:
+        jax.block_until_ready(make(2.0)(x))
+        snap = s.snapshot()
+        jax.block_until_ready(make(2.0)(x))
+        with pytest.raises(AssertionError, match="recompile"):
+            s.assert_no_recompile(snap, "second wrapper")
+
+
+@pytest.mark.sentinel
+def test_sentinel_budget_and_nesting():
+    @jax.jit
+    def g(x):
+        return x - 1
+
+    with RecompileSentinel() as outer:
+        with RecompileSentinel() as inner:
+            jax.block_until_ready(g(jnp.arange(5.0)))
+        assert inner.backend_compiles == outer.backend_compiles >= 1
+        with pytest.raises(AssertionError, match="budget"):
+            inner.assert_budget(0, "test")
+
+
+@pytest.mark.sentinel
+@pytest.mark.compile_budget(2)
+def test_compile_budget_marker_enforced():
+    @jax.jit
+    def h(x):
+        return x / 2
+
+    jax.block_until_ready(h(jnp.arange(4.0)))
+    jax.block_until_ready(h(jnp.arange(4.0)))  # warm: must not compile
+
+
+@pytest.mark.sentinel
+def test_production_kernel_hits_cache_on_second_call():
+    """wave_number is jitted at module level: a second same-shape call
+    must not compile anything."""
+    from raft_tpu.ops import waves
+
+    w = jnp.linspace(0.05, 2.0, 25)
+    w2 = jax.block_until_ready(jnp.linspace(0.06, 2.01, 25))
+    jax.block_until_ready(waves.wave_number(w, 180.0))
+    with RecompileSentinel() as s:
+        snap = s.snapshot()
+        jax.block_until_ready(waves.wave_number(w2, 180.0))
+        s.assert_no_recompile(snap, "warm wave_number")
+
+
+# ---------------------------------------------------------------------------
+# config behavior pinned by this PR
+# ---------------------------------------------------------------------------
+
+
+def test_compilation_cache_warns_on_cpu_with_explicit_path(tmp_path):
+    """On the CPU backend the persistent cache is a documented no-op —
+    but an explicitly requested path must warn, not vanish silently."""
+    import warnings
+
+    from raft_tpu.config import enable_compilation_cache
+
+    assert jax.default_backend() == "cpu"
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        out = enable_compilation_cache(str(tmp_path / "cache"))
+    assert out is None
+    assert any("CPU backend" in str(w.message) for w in caught)
+
+    # the implicit-path call stays silent (the common, intended case)
+    with warnings.catch_warnings(record=True) as caught:
+        warnings.simplefilter("always")
+        assert enable_compilation_cache() is None
+    assert caught == []

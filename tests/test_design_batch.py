@@ -287,3 +287,57 @@ def test_sweep_template_memoization():
         assert len(sweep_mod._TEMPLATE_MEMO) == 2
     finally:
         design_batch.make_batch_compiler = orig
+
+
+def test_turbine_variant_fowt_matches_full_model_build():
+    """_turbine_variant_fowt is the sweep's fast path for aero axes: a
+    shallow FOWT copy with just the rotors rebuilt from the mutated
+    turbine dict.  Its solver-facing outputs (rna_params_for pytree, hub
+    heights, and — with wind — the A/B aero-servo tables) must equal a
+    full Model build of the same mutated design, or turbine sweeps
+    silently diverge from the reference per-point rebuild."""
+    import copy
+
+    import jax
+
+    from raft_tpu import sweep as sweep_mod
+    from raft_tpu.core.model import Model
+    from raft_tpu.parallel.design_batch import rna_params_for
+
+    base = _demo()
+    m0 = base["turbine"]["mRNA"]
+    hub0 = base["turbine"]["hHub"]
+    axes = [("turbine.mRNA", [m0, 1.4 * m0]),
+            ("turbine.hHub", [hub0, hub0 + 12.0])]
+    combo = (1.4 * m0, hub0 + 12.0)
+
+    template = Model(copy.deepcopy(base))
+    fowt = template.fowtList[0]
+    fowt.setPosition(np.zeros(6))
+
+    fv = sweep_mod._turbine_variant_fowt(fowt, base, axes, [0, 1], combo)
+
+    d_full = copy.deepcopy(base)
+    d_full["turbine"]["mRNA"] = combo[0]
+    d_full["turbine"]["hHub"] = combo[1]
+    full = Model(d_full).fowtList[0]
+    full.setPosition(np.zeros(6))
+
+    rna_v = jax.tree_util.tree_map(np.asarray, rna_params_for(fv))
+    rna_f = jax.tree_util.tree_map(np.asarray, rna_params_for(full))
+    assert set(rna_v) == set(rna_f)
+    for key in rna_f:
+        np.testing.assert_allclose(rna_v[key], rna_f[key], rtol=1e-12,
+                                   atol=0, err_msg=key)
+    # the variant actually moved off the template (axis is live)
+    assert not np.allclose(rna_v["mRNA"],
+                           np.asarray(rna_params_for(fowt)["mRNA"]))
+
+    zh_v = np.asarray([float(r.r3[2]) for r in fv.rotorList])
+    zh_f = np.asarray([float(r.r3[2]) for r in full.rotorList])
+    np.testing.assert_allclose(zh_v, zh_f, rtol=1e-12)
+    assert zh_v[0] != pytest.approx(float(fowt.rotorList[0].r3[2]))
+
+    # the template FOWT must be untouched by the variant build
+    assert float(fowt.rotorList[0].mRNA) == pytest.approx(m0)
+    assert np.asarray(rna_params_for(fowt)["mRNA"])[0] == pytest.approx(m0)
