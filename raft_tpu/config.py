@@ -13,6 +13,49 @@ RHO_WATER = 1025.0
 RHO_AIR = 1.225
 GRAVITY = 9.81
 
+# ---------------------------------------------------------------------------
+# solve-health telemetry (raft_tpu.robust)
+# ---------------------------------------------------------------------------
+
+# Defaults for the SolveHealth channel threaded through the sweep solves
+# (see docs/robustness.md).  `enabled` turns the in-graph telemetry +
+# Tikhonov fallback on/off (off = the seed solver's exact trace);
+# `resid_tol` / `cond_tol` are HOST-side classification thresholds (a
+# change never recompiles anything); `tik_eps` / `tik_cond_tol` are
+# baked into the solver trace (the in-graph fallback needs them as
+# constants).  Environment overrides: RAFT_TPU_HEALTH=0 disables,
+# RAFT_TPU_HEALTH_RESID_TOL / RAFT_TPU_HEALTH_COND_TOL retune the
+# classifiers.
+SOLVE_HEALTH_DEFAULTS = {
+    "enabled": True,
+    "resid_tol": 1e-3,    # Borgman relative residual above this -> non-converged
+    "cond_tol": 1e-10,    # min/max pivot ratio below this -> ill-conditioned
+    "tik_eps": 1e-6,      # relative Tikhonov strength for flagged lanes
+    "tik_cond_tol": 1e-12,  # in-graph cond threshold that triggers the fallback
+}
+
+
+def health_config(overrides=None) -> dict:
+    """Effective solve-health configuration: defaults, then environment,
+    then explicit ``overrides`` (e.g. ``sweep(..., health={...})``)."""
+    import os
+
+    cfg = dict(SOLVE_HEALTH_DEFAULTS)
+    env = os.environ.get("RAFT_TPU_HEALTH")
+    if env is not None:
+        cfg["enabled"] = env not in ("0", "false", "")
+    for key, var in (("resid_tol", "RAFT_TPU_HEALTH_RESID_TOL"),
+                     ("cond_tol", "RAFT_TPU_HEALTH_COND_TOL")):
+        env = os.environ.get(var)
+        if env is not None:
+            cfg[key] = float(env)
+    if overrides:
+        unknown = set(overrides) - set(cfg)
+        if unknown:
+            raise ValueError(f"unknown health config key(s): {sorted(unknown)}")
+        cfg.update(overrides)
+    return cfg
+
 
 def enable_compilation_cache(path: str | None = None) -> str | None:
     """Turn on JAX's persistent (on-disk) compilation cache.

@@ -57,7 +57,10 @@ def _run_pair_rows(pair_rows, nw2, blk, seq_devices=None):
         return jax.lax.map(pair_rows, idx).reshape(npad, nw2, 6)[:nw2]
 
     from jax.sharding import Mesh, PartitionSpec as P
-    from jax import shard_map
+    try:
+        from jax import shard_map
+    except ImportError:  # moved in newer JAX; fall back for older
+        from jax.experimental.shard_map import shard_map
 
     nd = len(seq_devices)
     blk = min(blk, -(-nw2 // nd))  # don't pad past ~1 block per device

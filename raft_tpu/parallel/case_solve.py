@@ -124,7 +124,8 @@ def design_params(fowt, include_aero=True, device=None):
                     "rho": fowt.rho_water, "g": fowt.g}
 
 
-def make_parametric_solver(static, n_iter=15):
+def make_parametric_solver(static, n_iter=15, with_health=False,
+                           tik_eps=1e-6, tik_cond_tol=1e-12):
     """Pure function solve(params, zeta, beta[, aero]) -> Xi [nH,6,nw].
 
     ``static`` is the second return of :func:`design_params` (python
@@ -138,6 +139,19 @@ def make_parametric_solver(static, n_iter=15):
     rotor is unchanged), so the (design, case) vmap axes stay factored:
     params carries the platform, aero the operating point
     (raft_model.py:905-914).
+
+    ``with_health`` returns ``(Xi, SolveHealth)`` instead of bare
+    ``Xi``: the Borgman fixed-point residual is threaded through the
+    ``lax.scan`` carry, the final impedance solve reports its
+    pivot-conditioning signal, NaN/Inf lanes are detected in-graph, and
+    ω lanes that are non-finite or conditioned below ``tik_cond_tol``
+    fall back (via ``jnp.where``, branchless) to a Tikhonov-regularized
+    re-solve ``(Z + λI) Xi = F`` with ``λ = tik_eps · max|diag Z|``
+    instead of propagating NaN into the metrics.  All health leaves are
+    per-solve scalars, so they vmap/shard with the existing (design,
+    case) axes and add no program beyond the one jit that carries them
+    (see :mod:`raft_tpu.robust.health`).  The ``with_health=False``
+    trace is bit-identical to the seed solver.
     """
     nw = static["nw"]
     depth = static["depth"]
@@ -257,25 +271,85 @@ def make_parametric_solver(static, n_iter=15):
         # fixed-point drag linearization on the primary heading
         # (raft_model.py:918-991; fixed iteration count batches cleanly,
         # under-relaxation 0.2/0.8 matches the reference)
-        def body(Xi_last, _):
+        Xi0 = jnp.full((6, nw), XiStart, dtype=zeta.dtype)
+
+        if not with_health:
+            def body(Xi_last, _):
+                B6, Bmat = drag_terms(Xi_last)
+                TB = jnp.concatenate([Bmat, skew @ Bmat], axis=1)  # [N,6,3]
+                F0 = Fexc[0] + jnp.einsum("nsj,njw->sw", TB, u0)
+                Z = impedance(B6)
+                # batch-last fused Gauss-Jordan: the framework's hottest
+                # op (Pallas kernel on TPU, ~40x over jnp.linalg.solve)
+                Xi = smallsolve.solve_impedance(Z, F0)
+                return 0.2 * Xi_last + 0.8 * Xi, None
+
+            Xi_relaxed, _ = jax.lax.scan(body, Xi0, None, length=n_iter)
+
+            # final linearized system + response for every heading
+            B6, Bmat = drag_terms(Xi_relaxed)
+            Z = impedance(B6)
+            TB = jnp.concatenate([Bmat, skew @ Bmat], axis=1)
+            F_all = Fexc + jnp.einsum("nsj,hnjw->hsw", TB, u)
+            return smallsolve.solve_impedance_multi(Z, F_all)
+
+        # ----- health-instrumented variant -----------------------------
+        # Same fixed-point iteration, but the scan carry also tracks the
+        # relative residual ||Xi_k - Xi_{k-1}||_F / ||Xi_k||_F (the
+        # convergence signal the fixed-count scan otherwise discards)
+        # and sanitizes non-finite ω lanes back to the previous iterate
+        # so one diverged lane cannot NaN the whole iteration.
+        real_dt = w.dtype
+        tiny = jnp.asarray(np.finfo(np.float32).tiny, dtype=real_dt)
+
+        def fnorm(x):
+            return jnp.sqrt(jnp.sum(jnp.abs(x) ** 2))
+
+        def body_h(carry, _):
+            Xi_last, _resid, bad_any = carry
             B6, Bmat = drag_terms(Xi_last)
-            TB = jnp.concatenate([Bmat, skew @ Bmat], axis=1)  # [N,6,3]
+            TB = jnp.concatenate([Bmat, skew @ Bmat], axis=1)
             F0 = Fexc[0] + jnp.einsum("nsj,njw->sw", TB, u0)
             Z = impedance(B6)
-            # batch-last fused Gauss-Jordan: the framework's hottest op
-            # (Pallas kernel on TPU, ~40x over jnp.linalg.solve there)
             Xi = smallsolve.solve_impedance(Z, F0)
-            return 0.2 * Xi_last + 0.8 * Xi, None
+            Xi_new = 0.2 * Xi_last + 0.8 * Xi
+            bad_lane = jnp.any(~jnp.isfinite(Xi_new), axis=0)  # [nw]
+            Xi_safe = jnp.where(bad_lane[None, :], Xi_last, Xi_new)
+            resid = fnorm(Xi_safe - Xi_last) / (fnorm(Xi_safe) + tiny)
+            return (Xi_safe, resid.astype(real_dt),
+                    bad_any | jnp.any(bad_lane)), None
 
-        Xi0 = jnp.full((6, nw), XiStart, dtype=zeta.dtype)
-        Xi_relaxed, _ = jax.lax.scan(body, Xi0, None, length=n_iter)
+        carry0 = (Xi0, jnp.asarray(jnp.inf, dtype=real_dt),
+                  jnp.asarray(False))
+        (Xi_relaxed, resid, scan_bad), _ = jax.lax.scan(
+            body_h, carry0, None, length=n_iter)
 
-        # final linearized system + response for every heading
         B6, Bmat = drag_terms(Xi_relaxed)
         Z = impedance(B6)
         TB = jnp.concatenate([Bmat, skew @ Bmat], axis=1)
         F_all = Fexc + jnp.einsum("nsj,hnjw->hsw", TB, u)
-        return smallsolve.solve_impedance_multi(Z, F_all)
+        Xi_raw, cond = smallsolve.solve_impedance_multi_cond(Z, F_all)
+
+        # flagged lanes (ill-conditioned or non-finite) take the
+        # Tikhonov-regularized solution; jnp.where keeps it branchless
+        # so the program stays a single executable
+        bad_lane = ((cond < tik_cond_tol)
+                    | jnp.any(~jnp.isfinite(Xi_raw), axis=(0, 1)))  # [nw]
+        diag_mag = jnp.max(jnp.abs(jnp.einsum("wii->wi", Z)), axis=1)
+        lam = tik_eps * (diag_mag + 1.0)
+        Zreg = Z + lam[:, None, None] * jnp.eye(6, dtype=Z.dtype)
+        Xi_reg = smallsolve.solve_impedance_multi(Zreg, F_all)
+        Xi_out = jnp.where(bad_lane[None, None, :], Xi_reg, Xi_raw)
+
+        from ..robust.health import SolveHealth
+
+        health = SolveHealth(
+            resid=resid,
+            cond=jnp.min(cond),
+            nonfinite=scan_bad | jnp.any(~jnp.isfinite(Xi_raw)),
+            n_fallback=jnp.sum(bad_lane).astype(jnp.int32),
+        )
+        return Xi_out, health
 
     return solve
 

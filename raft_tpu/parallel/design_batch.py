@@ -250,6 +250,28 @@ def _vkey(v):
     return (a.shape, a.dtype.str, a.tobytes()) if a.dtype != object else repr(v)
 
 
+def variant_finite_mask(stacked):
+    """Per-design input-validity mask over a stacked leaf batch.
+
+    Returns bool [n_designs]: True where every float/complex leaf row is
+    finite.  A NaN/Inf smuggled into a design dict (an optimizer
+    overshooting, a bad YAML edit) otherwise flows silently through the
+    geometry compile into the solve; the sweep pre-marks such designs in
+    its ``status`` array so the health report names the input, not just
+    the NaN it produced.
+    """
+    if not stacked:
+        return np.ones(0, dtype=bool)
+    n = int(np.shape(stacked[0])[0])
+    mask = np.ones(n, dtype=bool)
+    for leaf in stacked:
+        a = np.asarray(leaf)
+        if (np.issubdtype(a.dtype, np.floating)
+                or np.issubdtype(a.dtype, np.complexfloating)):
+            mask &= np.isfinite(a.reshape(n, -1)).all(axis=1)
+    return mask
+
+
 # ---------------------------------------------------------------------------
 # device: batched design -> solver params
 # ---------------------------------------------------------------------------

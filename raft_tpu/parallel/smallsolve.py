@@ -33,14 +33,22 @@ import numpy as np
 from ..analysis.contracts import shape_contract
 
 
-def _gauss_jordan_rows(rows_r, rows_i, n):  # graftlint: static=n
+def _gauss_jordan_rows(rows_r, rows_i, n, track_cond=False):  # graftlint: static=n,track_cond
     """Unrolled complex Gauss-Jordan with partial pivoting on row lists.
 
     rows_*: list of n arrays [ncol, B] (matrix columns then RHS columns).
-    Returns the reduced rows (identity in the first n columns).
+    Returns the reduced rows (identity in the first n columns); with
+    ``track_cond`` also a per-lane conditioning signal
+    ``min |pivot| / max |pivot|`` over the n elimination steps —
+    recorded DURING elimination, so it reflects the pivots the solve
+    actually divided by (a near-zero pivot after partial pivoting means
+    the matrix itself is near-singular, e.g. zero-stiffness yaw).  Cost:
+    two fused vector min/max ops per step over [B] lanes — noise next to
+    the ~220 elimination ops.
     """
     rows_r = list(rows_r)
     rows_i = list(rows_i)
+    minpiv2 = maxpiv2 = None
     for kp in range(n):
         # --- partial pivot: among rows kp..n-1 pick max |a[kp]|^2 per lane
         if kp < n - 1:
@@ -67,6 +75,9 @@ def _gauss_jordan_rows(rows_r, rows_i, n):  # graftlint: static=n
         # --- normalize pivot row: row /= a[kp]
         dr, di = pr[kp], pi[kp]
         den = dr * dr + di * di
+        if track_cond:
+            minpiv2 = den if minpiv2 is None else jnp.minimum(minpiv2, den)
+            maxpiv2 = den if maxpiv2 is None else jnp.maximum(maxpiv2, den)
         inv_r = dr / den
         inv_i = -di / den
         nr = pr * inv_r[None, :] - pi * inv_i[None, :]
@@ -81,6 +92,11 @@ def _gauss_jordan_rows(rows_r, rows_i, n):  # graftlint: static=n
             fi = rows_i[ir][kp]
             rows_r[ir] = rows_r[ir] - (fr[None, :] * nr - fi[None, :] * ni)
             rows_i[ir] = rows_i[ir] - (fr[None, :] * ni + fi[None, :] * nr)
+    if track_cond:
+        # sqrt of the squared-magnitude ratio; a zero max (all-zero
+        # matrix) maps to cond 0 instead of 0/0
+        tiny = jnp.asarray(np.finfo(np.float32).tiny, dtype=maxpiv2.dtype)
+        return rows_r, rows_i, jnp.sqrt(minpiv2 / jnp.maximum(maxpiv2, tiny))
     return rows_r, rows_i
 
 
@@ -101,6 +117,23 @@ def solve_batchlast_jnp(Zr, Zi, Fr, Fi):
     return xr, xi
 
 
+@shape_contract("[n,n,nw],[n,n,nw],[n,m,nw],[n,m,nw]->[n,m,nw],[n,m,nw],[nw]")
+def solve_batchlast_jnp_cond(Zr, Zi, Fr, Fi):
+    """Like :func:`solve_batchlast_jnp` but also returns the per-lane
+    conditioning signal ``cond [B] = min |pivot| / max |pivot|`` from
+    the elimination (the in-graph solve-health channel; see
+    :mod:`raft_tpu.robust.health`)."""
+    n = Zr.shape[0]
+    m = Fr.shape[1]
+    rows_r = [jnp.concatenate([Zr[i], Fr[i]], axis=0) for i in range(n)]
+    rows_i = [jnp.concatenate([Zi[i], Fi[i]], axis=0) for i in range(n)]
+    rows_r, rows_i, cond = _gauss_jordan_rows(rows_r, rows_i, n,
+                                              track_cond=True)
+    xr = jnp.stack([rows_r[i][n:n + m] for i in range(n)], axis=0)
+    xi = jnp.stack([rows_i[i][n:n + m] for i in range(n)], axis=0)
+    return xr, xi, cond
+
+
 # ---------------------------------------------------------------------------
 # Pallas kernel: tile the batch (lane) axis through VMEM
 # ---------------------------------------------------------------------------
@@ -116,12 +149,27 @@ def _solve_kernel(zr_ref, zi_ref, fr_ref, fi_ref, xr_ref, xi_ref, *, n, m):
     xi_ref[:] = jnp.stack([rows_i[i][n:n + m] for i in range(n)], axis=0)
 
 
-@functools.partial(jax.jit, static_argnames=("interpret",))
-def solve_batchlast_pallas(Zr, Zi, Fr, Fi, interpret=False):
+def _solve_kernel_cond(zr_ref, zi_ref, fr_ref, fi_ref,
+                       xr_ref, xi_ref, cond_ref, *, n, m):
+    rows_r = [jnp.concatenate([zr_ref[i], fr_ref[i]], axis=0) for i in range(n)]
+    rows_i = [jnp.concatenate([zi_ref[i], fi_ref[i]], axis=0) for i in range(n)]
+    rows_r, rows_i, cond = _gauss_jordan_rows(rows_r, rows_i, n,
+                                              track_cond=True)
+    xr_ref[:] = jnp.stack([rows_r[i][n:n + m] for i in range(n)], axis=0)
+    xi_ref[:] = jnp.stack([rows_i[i][n:n + m] for i in range(n)], axis=0)
+    cond_ref[:] = cond[None, :]  # [1, block]: keep the output lane-aligned
+
+
+@functools.partial(jax.jit, static_argnames=("interpret", "with_cond"))
+def solve_batchlast_pallas(Zr, Zi, Fr, Fi, interpret=False, with_cond=False):  # graftlint: static=interpret,with_cond
     """Pallas version of :func:`solve_batchlast_jnp` (same signature).
 
     The batch axis B is padded to a lane-aligned block and gridded; each
-    program eliminates its [n, n+m, BLOCK] slab entirely in VMEM.
+    program eliminates its [n, n+m, BLOCK] slab entirely in VMEM.  With
+    ``with_cond`` the kernel also emits the per-lane pivot-conditioning
+    signal (identical arithmetic to :func:`solve_batchlast_jnp_cond`);
+    padded lanes carry identity matrices, so their cond is exactly 1 and
+    is sliced off with the padded solutions.
     """
     from jax.experimental import pallas as pl
 
@@ -143,6 +191,19 @@ def solve_batchlast_pallas(Zr, Zi, Fr, Fi, interpret=False):
     grid = (Bp // block,)
     zspec = pl.BlockSpec((n, n, block), lambda i: (0, 0, i))
     fspec = pl.BlockSpec((n, m, block), lambda i: (0, 0, i))
+    if with_cond:
+        cspec = pl.BlockSpec((1, block), lambda i: (0, i))
+        xr, xi, cond = pl.pallas_call(
+            functools.partial(_solve_kernel_cond, n=n, m=m),
+            out_shape=(jax.ShapeDtypeStruct((n, m, Bp), Zr.dtype),
+                       jax.ShapeDtypeStruct((n, m, Bp), Zr.dtype),
+                       jax.ShapeDtypeStruct((1, Bp), Zr.dtype)),
+            grid=grid,
+            in_specs=[zspec, zspec, fspec, fspec],
+            out_specs=(fspec, fspec, cspec),
+            interpret=interpret,
+        )(Zr_, Zi_, Fr_, Fi_)
+        return xr[..., :B], xi[..., :B], cond[0, :B]
     xr, xi = pl.pallas_call(
         functools.partial(_solve_kernel, n=n, m=m),
         out_shape=(jax.ShapeDtypeStruct((n, m, Bp), Zr.dtype),
@@ -198,6 +259,24 @@ def solve_impedance_multi(Z, F_all):
         xr, xi = solve_batchlast_jnp(jnp.real(Zt), jnp.imag(Zt),
                                      jnp.real(Ft), jnp.imag(Ft))
     return jnp.transpose(xr + 1j * xi, (1, 0, 2))
+
+
+@shape_contract("[nw,n,n],[nH,n,nw]->[nH,n,nw],[nw]")
+def solve_impedance_multi_cond(Z, F_all):
+    """:func:`solve_impedance_multi` plus the per-ω pivot-conditioning
+    signal ``cond [nw] = min |pivot| / max |pivot|`` recorded during the
+    elimination — the health channel the robust sweep threads through
+    ``SolveHealth`` (both the jnp and the Pallas path emit it)."""
+    Zt = jnp.transpose(Z, (1, 2, 0))              # [n, n, nw]
+    Ft = jnp.transpose(F_all, (1, 0, 2))          # [n, nH, nw]
+    if use_pallas():
+        xr, xi, cond = solve_batchlast_pallas(
+            jnp.real(Zt), jnp.imag(Zt), jnp.real(Ft), jnp.imag(Ft),
+            with_cond=True)
+    else:
+        xr, xi, cond = solve_batchlast_jnp_cond(
+            jnp.real(Zt), jnp.imag(Zt), jnp.real(Ft), jnp.imag(Ft))
+    return jnp.transpose(xr + 1j * xi, (1, 0, 2)), cond
 
 
 @shape_contract("[nw,n,n]->[nw,n,n]")

@@ -1,0 +1,33 @@
+"""Solve-health telemetry and fault-isolating sweep execution.
+
+Batched physics at production scale needs per-item health, not
+all-or-nothing runs: one pathological design in a thousand-design sweep
+must neither poison its rows with silent NaN nor kill the whole batch
+with an XLA error.  This package adds the three layers that make a
+sweep's failure modes observable and survivable:
+
+* :mod:`raft_tpu.robust.health` — the in-graph ``SolveHealth`` pytree
+  (Borgman residual, pivot-conditioning signal, NaN/Inf flags) carried
+  through the vmapped/sharded solves, plus the host-side status
+  classification (ok / non-converged / ill-conditioned / nan /
+  quarantined).
+* :mod:`raft_tpu.robust.quarantine` — retry-then-bisect fault isolation
+  for the sweep chunk loop: a chunk that raises is retried once, then
+  bisected until the poison designs are quarantined and every healthy
+  design still computes.
+* :mod:`raft_tpu.robust.report` — the end-of-sweep structured summary
+  (counts per failure class, worst residuals, quarantined combos).
+"""
+
+from .health import (  # noqa: F401
+    STATUS_ILLCOND,
+    STATUS_NAN,
+    STATUS_NONCONV,
+    STATUS_OK,
+    STATUS_QUARANTINED,
+    SolveHealth,
+    classify_health,
+    status_name,
+)
+from .quarantine import run_isolated  # noqa: F401
+from .report import build_report, format_report  # noqa: F401

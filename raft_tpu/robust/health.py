@@ -1,0 +1,114 @@
+"""In-graph solve-health telemetry and host-side status classification.
+
+The frequency-domain solve has three quiet failure modes the reference
+(and the seed framework) cannot distinguish from a healthy run:
+
+* the fixed-point Borgman drag linearization runs a fixed ``lax.scan``
+  count with no convergence signal (raft_model.py:918-991) — a
+  diverging design returns numbers that merely look like metrics;
+* the batched Gauss-Jordan impedance solve degrades on ill-conditioned
+  matrices (near-zero-stiffness yaw) without raising;
+* NaN/Inf from any stage propagates into result arrays that the sweep
+  initializes to NaN anyway, so "failed" and "not yet computed" are
+  indistinguishable.
+
+:class:`SolveHealth` is the small pytree the solver returns alongside
+``Xi``: because every leaf is a per-solve scalar, it vmaps over the
+(design, case) axes and shards over the device mesh exactly like the
+response metrics, at negligible cost.  Classification against the
+configured tolerances happens on the host (:func:`classify_health`), so
+changing a tolerance never invalidates a compiled executable.
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import numpy as np
+
+__all__ = [
+    "SolveHealth",
+    "STATUS_OK", "STATUS_NONCONV", "STATUS_ILLCOND", "STATUS_NAN",
+    "STATUS_QUARANTINED", "STATUS_NAMES",
+    "classify_health", "status_name", "reduce_design_status",
+]
+
+
+class SolveHealth(NamedTuple):
+    """Per-solve health telemetry (one entry per (design, case) after
+    vmapping the parametric solver).
+
+    NamedTuple = automatic JAX pytree: it vmaps, shards, and transfers
+    with the result arrays, no registration needed.
+    """
+
+    resid: object
+    """Relative Borgman fixed-point residual of the LAST iteration,
+    ``||Xi_k - Xi_{k-1}||_F / ||Xi_k||_F`` — the convergence signal the
+    fixed-count scan otherwise discards."""
+
+    cond: object
+    """Pivot-conditioning signal of the final impedance solve:
+    ``min over ω of (min |pivot| / max |pivot|)`` recorded during
+    Gauss-Jordan elimination.  1.0 = perfectly balanced pivots; values
+    near float eps mean the solve digits are gone (near-singular Z,
+    e.g. zero-stiffness yaw)."""
+
+    nonfinite: object
+    """True when any NaN/Inf appeared in the raw solution (before the
+    Tikhonov fallback) or leaked out of the drag-linearization scan."""
+
+    n_fallback: object
+    """Number of ω lanes whose solution came from the Tikhonov-
+    regularized re-solve instead of the raw solve (int32)."""
+
+
+# ---------------------------------------------------------------------------
+# status codes (int8; stored in sweep results and checkpoints)
+# ---------------------------------------------------------------------------
+
+STATUS_OK = 0           # computed, converged, well-conditioned, finite
+STATUS_NONCONV = 1      # computed but Borgman residual above tolerance
+STATUS_ILLCOND = 2      # computed but impedance pivots near-degenerate
+STATUS_NAN = 3          # non-finite solution or metrics
+STATUS_QUARANTINED = 4  # chunk kept raising; design isolated and skipped
+
+STATUS_NAMES = {
+    STATUS_OK: "ok",
+    STATUS_NONCONV: "non-converged",
+    STATUS_ILLCOND: "ill-conditioned",
+    STATUS_NAN: "nan",
+    STATUS_QUARANTINED: "quarantined",
+}
+
+
+def status_name(code):
+    return STATUS_NAMES.get(int(code), f"unknown({int(code)})")
+
+
+def classify_health(health, resid_tol, cond_tol):
+    """Map a (numpy) SolveHealth batch to int8 status codes, elementwise.
+
+    Severity is ordered NAN > ILLCOND > NONCONV > OK so statuses can be
+    reduced across cases with a plain ``max``.  Runs on fetched host
+    arrays — tolerances are plain Python floats, never baked into a
+    trace.
+    """
+    resid = np.asarray(health.resid)
+    cond = np.asarray(health.cond)
+    nonfinite = np.asarray(health.nonfinite)
+
+    status = np.zeros(resid.shape, dtype=np.int8)
+    status[np.asarray(resid > resid_tol) | ~np.isfinite(resid)] = STATUS_NONCONV
+    status = np.maximum(
+        status,
+        np.where(np.asarray(cond < cond_tol) | ~np.isfinite(cond),
+                 np.int8(STATUS_ILLCOND), np.int8(STATUS_OK)))
+    status = np.maximum(
+        status, np.where(nonfinite, np.int8(STATUS_NAN), np.int8(STATUS_OK)))
+    return status
+
+
+def reduce_design_status(status_per_case):
+    """[..., n_case] per-case statuses -> per-design worst status."""
+    return np.max(np.asarray(status_per_case, dtype=np.int8), axis=-1)

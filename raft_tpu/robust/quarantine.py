@@ -1,0 +1,104 @@
+"""Fault-isolating chunk execution: retry once, then bisect.
+
+The sweep executes designs in compiled chunks; a chunk that raises
+(XLA runtime error, device OOM, a geometry that breaks an executable's
+assumptions) previously killed the whole sweep.  Here the failing chunk
+is retried once (transient device faults), then bisected: each half
+re-runs through the same compiled executable (chunks are padded to a
+fixed shape, so no new XLA programs are built), recursively, until the
+poison designs are isolated.  Healthy designs in a failing chunk still
+compute; poison designs are *quarantined* — marked with
+``STATUS_QUARANTINED`` instead of silently staying NaN.
+
+The runner is deliberately generic: ``run`` is any callable mapping an
+index array to a dict of numpy row-arrays, so the sweep's batched and
+fallback paths (and the fault-injection tests) share one isolation
+mechanism.
+"""
+
+from __future__ import annotations
+
+import warnings
+
+import numpy as np
+
+__all__ = ["run_isolated"]
+
+
+def _merge(parts, idx_parts, n_rows):
+    """Reassemble per-sub-chunk result dicts into one dict of row arrays
+    aligned with the original index order; rows with no result (their
+    sub-chunk was fully quarantined) stay NaN."""
+    out = None
+    pos = 0
+    for part, part_idx in zip(parts, idx_parts):
+        if part is not None:
+            if out is None:
+                out = {
+                    key: np.full((n_rows,) + np.shape(val)[1:],
+                                 np.nan, dtype=np.asarray(val).dtype)
+                    if np.issubdtype(np.asarray(val).dtype, np.floating)
+                    or np.issubdtype(np.asarray(val).dtype, np.complexfloating)
+                    else np.zeros((n_rows,) + np.shape(val)[1:],
+                                  dtype=np.asarray(val).dtype)
+                    for key, val in part.items()
+                }
+            for key, val in part.items():
+                out[key][pos:pos + len(part_idx)] = np.asarray(val)
+        pos += len(part_idx)
+    return out
+
+
+def run_isolated(run, idx, retries=1, display=0, _depth=0):
+    """Execute ``run(idx)`` with fault isolation.
+
+    Parameters
+    ----------
+    run : callable(np.ndarray[int]) -> dict[str, np.ndarray]
+        Executes the given design indices and returns result rows
+        aligned with ``idx`` (leading axis ``len(idx)``).  May raise.
+    idx : array of design indices (any length >= 1).
+    retries : int
+        Immediate re-runs of the SAME index set before bisecting
+        (transient device faults).  Bisection halves run with
+        ``retries=0`` — one retry per originally-failing chunk, so a
+        hard-failing chunk costs O(log n) extra executions, not O(n).
+
+    Returns
+    -------
+    (results, quarantined) where ``results`` is the merged row dict
+    (NaN rows for quarantined designs; ``None`` if every design failed)
+    and ``quarantined`` is a bool mask aligned with ``idx``.
+    """
+    idx = np.asarray(idx)
+    n = len(idx)
+    last_err = None
+    for attempt in range(retries + 1):
+        try:
+            return run(idx), np.zeros(n, dtype=bool)
+        except Exception as e:  # noqa: BLE001 - isolation boundary
+            last_err = e
+            if attempt < retries and display:
+                print(f"sweep: chunk of {n} design(s) raised "
+                      f"{type(e).__name__}; retrying once")
+
+    if n == 1:
+        warnings.warn(
+            f"sweep: design index {int(idx[0])} quarantined after "
+            f"{type(last_err).__name__}: {last_err}",
+            RuntimeWarning, stacklevel=2)
+        return None, np.ones(1, dtype=bool)
+
+    if display:
+        print(f"sweep: chunk of {n} design(s) still failing "
+              f"({type(last_err).__name__}); bisecting to isolate")
+    mid = n // 2
+    halves = [idx[:mid], idx[mid:]]
+    parts, masks = [], []
+    for half in halves:
+        res, mask = run_isolated(run, half, retries=0, display=display,
+                                 _depth=_depth + 1)
+        parts.append(res)
+        masks.append(mask)
+    quarantined = np.concatenate(masks)
+    return _merge(parts, halves, n), quarantined

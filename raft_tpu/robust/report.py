@@ -1,0 +1,119 @@
+"""End-of-sweep health report: structured summary of how the batch fared.
+
+Aggregates the per-design status codes and health telemetry into the
+summary a thousand-design run actually needs: how many designs landed in
+each failure class, which ones were quarantined (with their axis
+combos), and where convergence/conditioning was worst.  The dict is
+always attached to the sweep result (``out["report"]``); the formatted
+text prints under ``display``.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from .health import STATUS_NAMES, STATUS_OK, STATUS_QUARANTINED
+
+__all__ = ["build_report", "format_report"]
+
+_TOP_K = 5
+
+
+def build_report(status, combos=None, axes=None, health=None):
+    """Structured health summary for a finished sweep.
+
+    Parameters
+    ----------
+    status : int8 [n_designs]
+        Per-design status codes (worst over cases).
+    combos : list of value tuples, optional
+        The factorial grid, for naming quarantined/failed designs.
+    axes : list of (path, values), optional
+        Axis definitions, for labeling combo entries.
+    health : dict, optional
+        Per-design health arrays (``resid`` [n_designs], ``cond``
+        [n_designs]) as the sweep collects them — worst over cases.
+
+    Returns a plain-python dict (JSON-serializable apart from numpy
+    scalars) with ``counts`` per status name, ``n_designs``,
+    ``quarantined`` / ``failed`` index lists, per-index ``combos``, and
+    ``worst_resid`` / ``worst_cond`` top-k entries.
+    """
+    status = np.asarray(status, dtype=np.int8)
+    n = len(status)
+    counts = {name: int(np.sum(status == code))
+              for code, name in STATUS_NAMES.items()}
+
+    def combo_of(i):
+        if combos is None or i >= len(combos):
+            return None
+        combo = combos[i]
+        if axes is not None:
+            return {str(path): _short(val)
+                    for (path, _), val in zip(axes, combo)}
+        return [_short(v) for v in combo]
+
+    quarantined = [int(i) for i in np.nonzero(status == STATUS_QUARANTINED)[0]]
+    failed = [int(i) for i in np.nonzero(status != STATUS_OK)[0]]
+    report = {
+        "n_designs": n,
+        "counts": counts,
+        "all_ok": bool(np.all(status == STATUS_OK)),
+        "quarantined": quarantined,
+        "failed": failed,
+        "failed_status": {i: STATUS_NAMES.get(int(status[i]), "?")
+                          for i in failed[:32]},
+        "failed_combos": {i: combo_of(i) for i in failed[:32]},
+    }
+
+    if health is not None:
+        resid = np.asarray(health.get("resid", np.full(n, np.nan)), dtype=float)
+        cond = np.asarray(health.get("cond", np.full(n, np.nan)), dtype=float)
+        # worst residual = largest; worst conditioning = smallest ratio
+        order_r = np.argsort(np.where(np.isfinite(resid), -resid, -np.inf))
+        order_c = np.argsort(np.where(np.isfinite(cond), cond, np.inf))
+        report["worst_resid"] = [
+            {"design": int(i), "resid": float(resid[i])}
+            for i in order_r[:_TOP_K] if np.isfinite(resid[i])]
+        report["worst_cond"] = [
+            {"design": int(i), "cond": float(cond[i])}
+            for i in order_c[:_TOP_K] if np.isfinite(cond[i])]
+    return report
+
+
+def _short(v):
+    """Compact repr of one axis value for the report (arrays elide)."""
+    a = np.asarray(v)
+    if a.dtype == object or a.ndim == 0:
+        return v if np.ndim(v) == 0 else repr(v)
+    if a.size <= 4:
+        return a.tolist()
+    return f"array{a.shape}"
+
+
+def format_report(report):
+    """Human-readable rendering of :func:`build_report`'s dict."""
+    lines = []
+    n = report["n_designs"]
+    counts = report["counts"]
+    n_bad = n - counts.get("ok", 0)
+    head = f"sweep health: {counts.get('ok', 0)}/{n} designs ok"
+    if n_bad == 0:
+        lines.append(head)
+        return "\n".join(lines)
+    parts = [f"{v} {k}" for k, v in counts.items() if k != "ok" and v]
+    lines.append(f"{head} ({', '.join(parts)})")
+    for i in report["failed"][:32]:
+        combo = report.get("failed_combos", {}).get(i)
+        suffix = f"  {combo}" if combo is not None else ""
+        name = report.get("failed_status", {}).get(i, "failed")
+        lines.append(f"  design {i}: {name}{suffix}")
+    if len(report["failed"]) > 32:
+        lines.append(f"  ... and {len(report['failed']) - 32} more")
+    for key, label, fmt in (("worst_resid", "worst residuals", "resid"),
+                            ("worst_cond", "worst conditioning", "cond")):
+        entries = report.get(key)
+        if entries:
+            body = ", ".join(f"#{e['design']}={e[fmt]:.3g}" for e in entries)
+            lines.append(f"  {label}: {body}")
+    return "\n".join(lines)

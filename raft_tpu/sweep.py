@@ -24,6 +24,8 @@ from __future__ import annotations
 
 import copy
 import itertools
+import warnings
+import zipfile
 
 import numpy as np
 import jax
@@ -31,11 +33,24 @@ import jax.numpy as jnp
 
 from . import profiling
 from .analysis.contracts import shape_contract
+from .config import health_config
 from .core.model import Model
 from .ops import waves
-from .parallel.design_batch import SweepAxisError, set_in_design, stack_variants
+from .parallel.design_batch import (SweepAxisError, set_in_design,
+                                    stack_variants, variant_finite_mask)
+from .robust import (STATUS_NAN, STATUS_OK, STATUS_QUARANTINED, SolveHealth,
+                     build_report, classify_health, format_report,
+                     run_isolated)
+from .robust.health import reduce_design_status
 
 __all__ = ["sweep", "set_in_design", "case_aero_params"]
+
+# Test seam for fault-injection: when set, called as
+# ``hook(idx, dispatch)`` in place of the chunk dispatch (``idx`` is the
+# padded design-index array, ``dispatch`` the real executor).  Lets the
+# robustness tests make one chunk raise or one design emit NaN without
+# building a pathological physics model (tests/test_robust.py).
+_CHUNK_EXEC_HOOK = None
 
 # In-process template memo: repeat sweeps of the SAME base design (new
 # axis values / sea states / wind cases) reuse the template model, the
@@ -236,7 +251,8 @@ def _sweep_signature(base_design, axes, combos, sea_states, n_iter, wind):
 
 
 def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
-          checkpoint=None, chunk_size=256, wind=None, devices=None):
+          checkpoint=None, chunk_size=256, wind=None, devices=None,
+          health=None):
     """Run a factorial design sweep.
 
     Parameters
@@ -272,7 +288,20 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         (atomically), and a re-run of the same sweep resumes from the
         first unfinished chunk — the sweep-level resumability SURVEY.md
         §5 calls for (the reference's serial sweep restarts from scratch).
-        A checkpoint from a *different* sweep signature is ignored.
+        A checkpoint from a *different* sweep signature is ignored, and a
+        corrupt/unreadable checkpoint file is warned about and treated as
+        absent (the sweep starts fresh) instead of raising.  Checkpoints
+        written by older versions (no ``status`` array) resume with the
+        already-done designs marked ok.
+    health : bool or dict, optional
+        Solve-health telemetry configuration
+        (:data:`raft_tpu.config.SOLVE_HEALTH_DEFAULTS`): ``False``
+        disables the in-graph health channel (the seed solver's exact
+        trace), ``True``/``None`` uses the defaults + environment
+        overrides, a dict overrides individual keys.  ``resid_tol`` /
+        ``cond_tol`` classify on the host and never recompile anything;
+        ``tik_eps`` / ``tik_cond_tol`` are constants of the solver trace.
+        See docs/robustness.md.
 
     Returns
     -------
@@ -284,9 +313,14 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
     properties 'mass' [kg], 'displacement'
     (displaced mass rho*V [kg], getOutputs convention), 'GMT' [m]
     [n_designs] (the quantities the reference sweep's getOutputs
-    collects; NaN on the per-variant fallback path).  Feed the result
-    to :func:`raft_tpu.sweep_post.plot_sweep_contours` for the
-    reference-style contour figures (parametersweep.py:119-561).
+    collects; NaN on the per-variant fallback path).  Also attached:
+    'status' int8 [n_designs] per-design health codes (0 ok,
+    1 non-converged, 2 ill-conditioned, 3 nan, 4 quarantined — worst
+    over cases; see raft_tpu.robust.health), 'health' (per-design worst
+    Borgman residual and pivot-conditioning ratio), and 'report' (the
+    structured end-of-sweep summary, printed when ``display``).  Feed
+    the result to :func:`raft_tpu.sweep_post.plot_sweep_contours` for
+    the reference-style contour figures (parametersweep.py:119-561).
     """
     import os
 
@@ -299,6 +333,14 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
     if wind is not None and len(wind) != n_cases:
         raise ValueError("wind must align with sea_states (one case dict each)")
 
+    if health is False:
+        hcfg = health_config({"enabled": False})
+    elif health is None or health is True:
+        hcfg = health_config()
+    else:
+        hcfg = health_config(dict(health))
+    run_health = bool(hcfg["enabled"])
+
     mesh = None
     if devices is not None:
         devices = list(devices)
@@ -309,27 +351,69 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             n_design_ax = mesh.devices.shape[0]
             mesh_sig = (mesh.devices.shape, tuple(str(d) for d in devices))
 
-    results = np.full((n_designs, n_cases, 6), np.nan)
-    nacelle_acc = np.full((n_designs, n_cases), np.nan)
-    props = {k: np.full(n_designs, np.nan) for k in ("mass", "displacement", "GMT")}
-    done = np.zeros(n_designs, dtype=bool)
+    def _fresh_state():
+        return (np.full((n_designs, n_cases, 6), np.nan),
+                np.full((n_designs, n_cases), np.nan),
+                {k: np.full(n_designs, np.nan)
+                 for k in ("mass", "displacement", "GMT")},
+                np.zeros(n_designs, dtype=bool),
+                np.zeros(n_designs, dtype=np.int8),
+                np.full(n_designs, np.nan),
+                np.full(n_designs, np.nan))
+
+    # status: per-design int8 health codes (raft_tpu.robust.health).
+    # `done` keeps its resume semantics — "this design needs no more
+    # work" — which now covers both computed AND given-up (quarantined)
+    # designs; `status` is what distinguishes them.
+    (results, nacelle_acc, props, done,
+     status, health_resid, health_cond) = _fresh_state()
     sig = None
     if checkpoint:
         sig = _sweep_signature(base_design, axes, combos, sea_states, n_iter, wind)
         if os.path.exists(checkpoint):
-            with np.load(checkpoint, allow_pickle=False) as dat:
-                if (str(dat["sig"]) == sig and dat["motion_std"].shape == results.shape
-                        and "AxRNA_std" in dat and all(k in dat for k in props)):
-                    results = np.array(dat["motion_std"])
-                    nacelle_acc = np.array(dat["AxRNA_std"])
-                    done = np.array(dat["done"])
-                    for k in props:
-                        props[k] = np.array(dat[k])
-                    if display:
-                        print(f"sweep resume: {int(done.sum())}/{n_designs} designs already done")
+            # a half-written/corrupt checkpoint (killed mid-save, disk
+            # full, truncated copy) must not be able to kill the sweep it
+            # exists to protect: unreadable -> warn and start fresh
+            try:
+                with np.load(checkpoint, allow_pickle=False) as dat:
+                    if (str(dat["sig"]) == sig and dat["motion_std"].shape == results.shape
+                            and "AxRNA_std" in dat and all(k in dat for k in props)):
+                        results = np.array(dat["motion_std"])
+                        nacelle_acc = np.array(dat["AxRNA_std"])
+                        done = np.array(dat["done"])
+                        for k in props:
+                            props[k] = np.array(dat[k])
+                        # old-schema checkpoints (pre-status) resume with
+                        # already-done designs treated as ok (zeros)
+                        if "status" in dat and dat["status"].shape == status.shape:
+                            status = np.array(dat["status"], dtype=np.int8)
+                        if "health_resid" in dat and dat["health_resid"].shape == health_resid.shape:
+                            health_resid = np.array(dat["health_resid"])
+                        if "health_cond" in dat and dat["health_cond"].shape == health_cond.shape:
+                            health_cond = np.array(dat["health_cond"])
+                        if display:
+                            print(f"sweep resume: {int(done.sum())}/{n_designs} designs already done")
+            except (zipfile.BadZipFile, ValueError, KeyError, OSError, EOFError) as e:
+                warnings.warn(
+                    f"sweep: checkpoint {checkpoint!r} unreadable "
+                    f"({type(e).__name__}: {e}); starting fresh",
+                    RuntimeWarning)
+                (results, nacelle_acc, props, done,
+                 status, health_resid, health_cond) = _fresh_state()
+
+    def _finalize():
+        out = {"grid": combos, "motion_std": results,
+               "AxRNA_std": nacelle_acc, **props,
+               "status": status,
+               "health": {"resid": health_resid, "cond": health_cond}}
+        out["report"] = build_report(status, combos=combos, axes=axes,
+                                     health=out["health"])
+        if display:
+            print(format_report(out["report"]))
+        return out
+
     if done.all():
-        return {"grid": combos, "motion_std": results,
-                "AxRNA_std": nacelle_acc, **props}
+        return _finalize()
 
     # template model: frequency grid, rotors, mooring topology, fallback base.
     # Only the rotors need positioning (RNA constants + aero); the member
@@ -448,7 +532,13 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         # and checks treedef+spec (the packed transfer layout)
         place_sig = (mesh_sig if mesh is not None
                      else str(device) if device is not None else None)
-        jit_key = (mode, place_sig, chunk_size, n_cases, len(av_combos))
+        # the health channel changes the traced programs (extra outputs,
+        # residual-carrying scan, Tikhonov constants), so it is part of
+        # the executable identity
+        health_sig = ((True, hcfg["tik_eps"], hcfg["tik_cond_tol"])
+                      if run_health else (False,))
+        jit_key = (mode, place_sig, chunk_size, n_cases, len(av_combos),
+                   health_sig)
         if (memo is not None and memo["treedef"] == treedef
                 and memo.get("spec") == spec):
             jitted = memo["jitted"].get(jit_key)
@@ -491,7 +581,9 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             # unchanged — params is consumed on-device by B.
             import threading
 
-            solve_p = make_parametric_solver(static, n_iter=n_iter)
+            solve_p = make_parametric_solver(
+                static, n_iter=n_iter, with_health=run_health,
+                tik_eps=hcfg["tik_eps"], tik_cond_tol=hcfg["tik_cond_tol"])
             # nacelle positions for the acceleration channel (constant
             # across platform-geometry variants; per-variant along turbine
             # axes); the reported channel is the max over rotors, matching
@@ -513,6 +605,20 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 return jax.tree_util.tree_unflatten(
                     treedef, _unpack_leaves(packed, spec, n_leaves))
 
+            def _postB(out, zh):
+                """Metrics (+ health) from the double-vmapped solve."""
+                if not run_health:
+                    return _metrics(out, zh)
+                Xi, hb = out  # hb leaves: [chunk, ncase]
+                std, a_std = _metrics(Xi, zh)
+                # escalate metric non-finiteness into the health flag so
+                # a status-ok row can never carry NaN
+                hb = hb._replace(
+                    nonfinite=hb.nonfinite
+                    | ~jnp.all(jnp.isfinite(std), axis=-1)
+                    | ~jnp.isfinite(a_std))
+                return std, a_std, hb
+
             if mode in ("sel", "sel_wind"):
                 def partA(packed, rna_table, av):
                     geoms, moor = _leaves(packed)
@@ -527,27 +633,27 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
 
             if mode == "plain":
                 def partB(params, zetas, betas):
-                    Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
-                                  in_axes=(0, None, None))(params, zetas, betas)
-                    zh = jnp.broadcast_to(z_hubs, (Xi.shape[0],) + z_hubs.shape)
-                    return _metrics(Xi, zh)
+                    out = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
+                                   in_axes=(0, None, None))(params, zetas, betas)
+                    zh = jnp.broadcast_to(z_hubs, (params["w"].shape[0],) + z_hubs.shape)
+                    return _postB(out, zh)
             elif mode == "aero":
                 def partB(params, zetas, betas, aero):
-                    Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0, 0)),
-                                  in_axes=(0, None, None, None))(params, zetas, betas, aero)
-                    zh = jnp.broadcast_to(z_hubs, (Xi.shape[0],) + z_hubs.shape)
-                    return _metrics(Xi, zh)
+                    out = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0, 0)),
+                                   in_axes=(0, None, None, None))(params, zetas, betas, aero)
+                    zh = jnp.broadcast_to(z_hubs, (params["w"].shape[0],) + z_hubs.shape)
+                    return _postB(out, zh)
             elif mode == "sel":
                 def partB(params, zetas, betas, zh_table, av):
-                    Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
-                                  in_axes=(0, None, None))(params, zetas, betas)
-                    return _metrics(Xi, zh_table[av])
+                    out = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
+                                   in_axes=(0, None, None))(params, zetas, betas)
+                    return _postB(out, zh_table[av])
             else:  # sel_wind
                 def partB(params, zetas, betas, sel, av):
                     aero_v = {"A": sel["A"][av], "B": sel["B"][av]}
-                    Xi = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0, 0)),
-                                  in_axes=(0, None, None, 0))(params, zetas, betas, aero_v)
-                    return _metrics(Xi, sel["zh"][av])
+                    out = jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0, 0)),
+                                   in_axes=(0, None, None, 0))(params, zetas, betas, aero_v)
+                    return _postB(out, sel["zh"][av])
 
             if mesh is None:
                 jA, jB = jax.jit(partA), jax.jit(partB)
@@ -568,7 +674,11 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                     inB = ((d_sh, c_sh, c_sh) if mode == "plain"
                            else (d_sh, c_sh, c_sh, c_sh))
                 jA = jax.jit(partA, in_shardings=inA, out_shardings=(d_sh, d_sh))
-                jB = jax.jit(partB, in_shardings=inB, out_shardings=(dc, dc))
+                # the health pytree's leaves are [chunk, ncase] like the
+                # metrics, so the same (design, case) sharding applies as
+                # a pytree prefix
+                outB_sh = (dc, dc, dc) if run_health else (dc, dc)
+                jB = jax.jit(partB, in_shardings=inB, out_shardings=outB_sh)
                 sds = lambda sh, dt: jax.ShapeDtypeStruct(sh, dt)
 
             fdt = np.dtype(zetas.dtype)
@@ -637,7 +747,12 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             tA.start()
             threads.append(tA)
 
-            params_sds = lA.out_info[1]
+            # lowered.out_info leaves are OutInfo objects on recent JAX,
+            # which .lower() rejects as abstract arguments — re-wrap as
+            # plain ShapeDtypeStructs (jB carries explicit in_shardings)
+            params_sds = jax.tree_util.tree_map(
+                lambda o: jax.ShapeDtypeStruct(o.shape, o.dtype),
+                lA.out_info[1])
             nrot = max(1, len(fowt.rotorList))
             if mode == "plain":
                 argsB = (params_sds, zetas, betas)
@@ -704,11 +819,16 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 for t in threads:
                     t.join()
             cA, cB = built.get("A"), built.get("B")
-            if warm_failures and display:
-                for key, err in sorted(warm_failures.items()):
-                    print(f"sweep: warm-exec of part {key} failed "
-                          f"({type(err).__name__}: {err}); first chunk "
-                          "will pay executable initialization")
+            # surfaced unconditionally: a failed warm run usually means
+            # every chunk pays the upload cost it was meant to hide, and
+            # headless/CI runs (display=0) must see that too
+            for key, err in sorted(warm_failures.items()):
+                msg = (f"sweep: warm-exec of part {key} failed "
+                       f"({type(err).__name__}: {err}); first chunk "
+                       "will pay executable initialization")
+                warnings.warn(msg, RuntimeWarning)
+                if display:
+                    print(msg)
             if isinstance(cA, Exception) or isinstance(cB, Exception):
                 # AOT failed (e.g. an exotic sharding/backend combination):
                 # fall back to the plain jits, which compile inline at the
@@ -737,6 +857,11 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                     stacks.pop(next(iter(stacks)))
                 stacks[stack_key] = (stacked, treedef, aero_axes)
 
+        # input-validity premark: designs whose stacked leaves carry
+        # NaN/Inf are flagged NAN even if the solve happens to return
+        # finite garbage for them
+        input_ok = variant_finite_mask(stacked)
+
         with profiling.phase("sweep/chunks"):
             # software-pipelined with bounded depth: chunk k+1's transfers
             # and executables are queued before chunk k's results are
@@ -748,18 +873,143 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             _PIPELINE = 2
             pending = []
 
-            def _commit(entry):
-                start, stop, n_real, std, a_std, pr = entry
-                results[start:stop] = np.asarray(std)[:n_real]
-                nacelle_acc[start:stop] = np.asarray(a_std)[:n_real]
+            def _dispatch(idx):
+                """Queue one padded chunk; returns un-fetched device
+                results (std, a_std, props, health-or-None)."""
+                dispatch = _dispatch_real
+                if _CHUNK_EXEC_HOOK is not None:
+                    return _CHUNK_EXEC_HOOK(np.asarray(idx), dispatch)
+                return dispatch(idx)
+
+            def _dispatch_real(idx):
+                packed = [put_d(b) for b in _pack_rows(stacked, spec, idx)]
+                if mode == "plain":
+                    pr, params = cA(packed)
+                    outB = cB(params, zetas, betas)
+                elif mode == "aero":
+                    pr, params = cA(packed)
+                    outB = cB(params, zetas, betas, aero)
+                else:
+                    av_dev = put_d(aero_idx[idx])
+                    pr, params = cA(packed, sel_variants["rna"], av_dev)
+                    if mode == "sel":
+                        outB = cB(params, zetas, betas,
+                                  sel_variants["zh"], av_dev)
+                    else:
+                        outB = cB(params, zetas, betas,
+                                  {k: sel_variants[k] for k in ("A", "B", "zh")},
+                                  av_dev)
+                if run_health:
+                    std, a_std, hb = outB
+                else:
+                    (std, a_std), hb = outB, None
+                return std, a_std, pr, hb
+
+            def _classify_rows(rows_idx, std_rows, a_std_rows, hb_rows):
+                """int8 per-design status for fetched numpy chunk rows."""
+                fin = (np.isfinite(std_rows).all(axis=-1)
+                       & np.isfinite(a_std_rows))  # [n, ncase]
+                st = np.where(fin, np.int8(STATUS_OK),
+                              np.int8(STATUS_NAN)).astype(np.int8)
+                if hb_rows is not None:
+                    st = np.maximum(st, classify_health(
+                        SolveHealth(**hb_rows),
+                        hcfg["resid_tol"], hcfg["cond_tol"]))
+                st = reduce_design_status(st)  # worst over cases -> [n]
+                return np.maximum(
+                    st, np.where(input_ok[rows_idx], np.int8(STATUS_OK),
+                                 np.int8(STATUS_NAN)))
+
+            def _store_rows(rows_idx, std_rows, a_std_rows, pr_rows, hb_rows):
+                """Write fetched rows + their status into the result
+                arrays (rows_idx: absolute design indices)."""
+                results[rows_idx] = std_rows
+                nacelle_acc[rows_idx] = a_std_rows
                 for k in props:
-                    props[k][start:stop] = np.asarray(pr[k])[:n_real]
-                done[start:stop] = True
-                if display:
-                    print(f"sweep: designs {start+1}-{stop}/{n_designs} done")
+                    props[k][rows_idx] = pr_rows[k]
+                if hb_rows is not None:
+                    health_resid[rows_idx] = np.max(hb_rows["resid"], axis=-1)
+                    health_cond[rows_idx] = np.min(hb_rows["cond"], axis=-1)
+                status[rows_idx] = _classify_rows(rows_idx, std_rows,
+                                                  a_std_rows, hb_rows)
+                done[rows_idx] = True
                 if checkpoint:
                     _save_checkpoint(checkpoint, sig, results, done, props,
-                                     nacelle_acc)
+                                     nacelle_acc, status, health_resid,
+                                     health_cond)
+
+            def _commit(entry):
+                start, stop, n_real, std, a_std, pr, hb = entry
+                hb_rows = None
+                if hb is not None:
+                    hb_rows = {k: np.asarray(v)[:n_real]
+                               for k, v in hb._asdict().items()}
+                _store_rows(np.arange(start, stop),
+                            np.asarray(std)[:n_real],
+                            np.asarray(a_std)[:n_real],
+                            {k: np.asarray(pr[k])[:n_real] for k in props},
+                            hb_rows)
+                if display:
+                    print(f"sweep: designs {start+1}-{stop}/{n_designs} done")
+
+            def _exec_rows(sub_idx):
+                """Quarantine-runner callable: arbitrary-length design
+                index array -> fetched numpy row dict.  Pads with the
+                last index so the SAME compiled chunk executables serve
+                every bisection level (no new XLA programs)."""
+                sub_idx = np.asarray(sub_idx, dtype=np.int64)
+                n_r = sub_idx.size
+                idx = np.full(chunk_size, sub_idx[-1], dtype=np.int64)
+                idx[:n_r] = sub_idx
+                std, a_std, pr, hb = _dispatch(idx)
+                rows = {"std": np.asarray(std)[:n_r],
+                        "a_std": np.asarray(a_std)[:n_r],
+                        **{f"prop_{k}": np.asarray(pr[k])[:n_r]
+                           for k in props}}
+                if hb is not None:
+                    for k, v in hb._asdict().items():
+                        rows[k] = np.asarray(v)[:n_r]
+                return rows
+
+            def _isolate(start, stop, err):
+                """A chunk raised (dispatch or fetch): re-run it through
+                the retry-then-bisect runner so only the poison designs
+                are lost."""
+                warnings.warn(
+                    f"sweep: chunk {start}-{stop} raised "
+                    f"({type(err).__name__}: {err}); isolating faults",
+                    RuntimeWarning)
+                rows_idx = np.arange(start, stop)
+                merged, quarantined = run_isolated(
+                    _exec_rows, rows_idx, retries=1, display=display)
+                ok = ~quarantined
+                if merged is not None and ok.any():
+                    hb_rows = None
+                    if "resid" in merged:
+                        hb_rows = {k: merged[k][ok] for k in
+                                   ("resid", "cond", "nonfinite", "n_fallback")}
+                    _store_rows(rows_idx[ok], merged["std"][ok],
+                                merged["a_std"][ok],
+                                {k: merged[f"prop_{k}"][ok] for k in props},
+                                hb_rows)
+                status[rows_idx[quarantined]] = STATUS_QUARANTINED
+                done[rows_idx] = True
+                if checkpoint:
+                    _save_checkpoint(checkpoint, sig, results, done, props,
+                                     nacelle_acc, status, health_resid,
+                                     health_cond)
+                if display:
+                    print(f"sweep: designs {start+1}-{stop}/{n_designs} done "
+                          f"({int(quarantined.sum())} quarantined)")
+
+            def _safe_commit(entry):
+                # dispatch is async: a poison chunk often raises only at
+                # the device->host fetch, i.e. here rather than in
+                # _dispatch
+                try:
+                    _commit(entry)
+                except Exception as e:  # noqa: BLE001 - isolation boundary
+                    _isolate(entry[0], entry[1], e)
 
             for start in range(0, n_designs, chunk_size):
                 stop = min(start + chunk_size, n_designs)
@@ -772,30 +1022,17 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 n_real = stop - start
                 idx = np.arange(start, start + chunk_size)
                 idx[n_real:] = stop - 1
-                packed = [put_d(b) for b in _pack_rows(stacked, spec, idx)]
-                if mode == "plain":
-                    pr, params = cA(packed)
-                    std, a_std = cB(params, zetas, betas)
-                elif mode == "aero":
-                    pr, params = cA(packed)
-                    std, a_std = cB(params, zetas, betas, aero)
-                else:
-                    av_dev = put_d(aero_idx[idx])
-                    pr, params = cA(packed, sel_variants["rna"], av_dev)
-                    if mode == "sel":
-                        std, a_std = cB(params, zetas, betas,
-                                        sel_variants["zh"], av_dev)
-                    else:
-                        std, a_std = cB(params, zetas, betas,
-                                        {k: sel_variants[k] for k in ("A", "B", "zh")},
-                                        av_dev)
-                pending.append((start, stop, n_real, std, a_std, pr))
+                try:
+                    entry = (start, stop, n_real) + _dispatch(idx)
+                except Exception as e:  # noqa: BLE001 - isolation boundary
+                    _isolate(start, stop, e)
+                    continue
+                pending.append(entry)
                 while len(pending) >= _PIPELINE:
-                    _commit(pending.pop(0))
+                    _safe_commit(pending.pop(0))
             for entry in pending:
-                _commit(entry)
-        return {"grid": combos, "motion_std": results,
-                "AxRNA_std": nacelle_acc, **props}
+                _safe_commit(entry)
+        return _finalize()
 
     # ----- fallback: per-variant model compile, batched device solve -----
     zetas, betas = _sea_state_waves(fowt, sea_states)
@@ -807,18 +1044,36 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             continue
 
         params_list = []
+        row_idx = []
         static = template = None
         for ic in range(start, stop):
-            p, static, template = _compile_variant(base_design, axes, combos[ic], device)
+            # the per-variant Model build runs arbitrary host geometry
+            # code per design — the natural fault boundary on this path:
+            # a design that cannot even build is quarantined, not fatal
+            try:
+                p, static, template = _compile_variant(base_design, axes, combos[ic], device)
+            except Exception as e:  # noqa: BLE001 - isolation boundary
+                warnings.warn(
+                    f"sweep: design {ic} {combos[ic]!r} failed to build "
+                    f"({type(e).__name__}: {e}); quarantined",
+                    RuntimeWarning)
+                status[ic] = STATUS_QUARANTINED
+                done[ic] = True
+                continue
             params_list.append(p)
+            row_idx.append(ic)
             if display:
                 print(f"compiled design {ic+1}/{n_designs}: {combos[ic]}")
+        if not params_list:
+            continue
         n_real = len(params_list)
         if n_designs > chunk_size:
             params_list += [params_list[-1]] * (chunk_size - n_real)
 
         if batched is None:
-            solve_p = make_parametric_solver(static, n_iter=n_iter)
+            solve_p = make_parametric_solver(
+                static, n_iter=n_iter, with_health=run_health,
+                tik_eps=hcfg["tik_eps"], tik_cond_tol=hcfg["tik_cond_tol"])
             if aero is None:
                 batched = jax.jit(jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
                                            in_axes=(0, None, None)))
@@ -828,26 +1083,41 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
 
         params_stacked = jax.tree_util.tree_map(lambda *xs: jnp.stack(xs), *params_list)
         if aero is None:
-            Xi = batched(params_stacked, zetas, betas)  # [chunk, ncase, 1, 6, nw]
+            out = batched(params_stacked, zetas, betas)  # Xi [chunk, ncase, 1, 6, nw]
         else:
-            Xi = batched(params_stacked, zetas, betas, aero)
-        results[start:stop] = np.asarray(
+            out = batched(params_stacked, zetas, betas, aero)
+        Xi, hb = out if run_health else (out, None)
+        ridx = np.asarray(row_idx)
+        rows = np.asarray(
             jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1)))[:n_real]
-        done[start:stop] = True
+        results[ridx] = rows
+        done[ridx] = True
+        st = np.where(np.isfinite(rows).all(axis=-1), np.int8(STATUS_OK),
+                      np.int8(STATUS_NAN)).astype(np.int8)  # [n_real, ncase]
+        if hb is not None:
+            hb_rows = {k: np.asarray(v)[:n_real]
+                       for k, v in hb._asdict().items()}
+            st = np.maximum(st, classify_health(
+                SolveHealth(**hb_rows), hcfg["resid_tol"], hcfg["cond_tol"]))
+            health_resid[ridx] = np.max(hb_rows["resid"], axis=-1)
+            health_cond[ridx] = np.min(hb_rows["cond"], axis=-1)
+        status[ridx] = reduce_design_status(st)
 
         if checkpoint:
-            _save_checkpoint(checkpoint, sig, results, done, props, nacelle_acc)
+            _save_checkpoint(checkpoint, sig, results, done, props,
+                             nacelle_acc, status, health_resid, health_cond)
 
     # the per-variant path reports the motion response only (AxRNA/props
     # stay NaN, same keys as the batched path)
-    return {"grid": combos, "motion_std": results,
-            "AxRNA_std": nacelle_acc, **props}
+    return _finalize()
 
 
-def _save_checkpoint(checkpoint, sig, results, done, props, nacelle_acc):
+def _save_checkpoint(checkpoint, sig, results, done, props, nacelle_acc,
+                     status, health_resid, health_cond):
     import os
 
     tmp = f"{checkpoint}.{os.getpid()}.tmp.npz"  # .npz: savez keeps the name
     np.savez(tmp, sig=sig, motion_std=results, done=done, AxRNA_std=nacelle_acc,
+             status=status, health_resid=health_resid, health_cond=health_cond,
              **props)
     os.replace(tmp, checkpoint)

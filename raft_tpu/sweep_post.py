@@ -87,13 +87,24 @@ def plot_sweep_contours(out, axes, metrics=None, out_dir=".", prefix="sweep",
     # assemble available per-design metrics
     fields = {}
     ms = np.asarray(out["motion_std"])  # [nd, ncase, 6]
+    # unhealthy designs (non-converged/ill-conditioned/nan/quarantined;
+    # see raft_tpu.robust.health) plot as holes, not as plausible-looking
+    # garbage contours
+    bad = None
+    if "status" in out:
+        bad = np.asarray(out["status"]) != 0
+        if bad.any():
+            ms = np.where(bad[:, None, None], np.nan, ms)
     dof = ["surge", "sway", "heave", "roll", "pitch", "yaw"]
     worst = ms.max(axis=1)  # worst sea state per design
     for i, name in enumerate(dof):
         fields[f"{name}_std"] = worst[:, i]
     for key in ("mass", "displacement", "GMT"):
         if key in out:
-            fields[key] = np.asarray(out[key])
+            vals = np.asarray(out[key])
+            if bad is not None and bad.any():
+                vals = np.where(bad, np.nan, vals)
+            fields[key] = vals
     if metrics is not None:
         fields = {k: fields[k] for k in metrics}
 

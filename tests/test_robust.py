@@ -1,0 +1,265 @@
+"""Solve-health telemetry and fault-isolating sweep execution.
+
+Fault injection happens through ``raft_tpu.sweep._CHUNK_EXEC_HOOK`` (the
+dispatch seam): tests make one chunk raise or one design emit NaN
+without constructing a pathological physics model, then assert the sweep
+still completes, quarantines/flags exactly the right designs, and keeps
+every status-ok row NaN-free.
+"""
+
+import numpy as np
+import pytest
+
+from raft_tpu import sweep as sweep_mod
+from raft_tpu.designs import demo_spar
+from raft_tpu.robust import (STATUS_NAN, STATUS_OK, STATUS_QUARANTINED,
+                             SolveHealth, build_report, classify_health,
+                             format_report, run_isolated)
+from raft_tpu.robust.health import (STATUS_ILLCOND, STATUS_NONCONV,
+                                    reduce_design_status, status_name)
+
+AXES = [("platform.members.0.d",
+         [[9.4, 9.4, 6.5, 6.5], [10.0, 10.0, 6.5, 6.5],
+          [10.5, 10.5, 6.5, 6.5], [11.0, 11.0, 6.5, 6.5]])]
+STATES = [(4.0, 8.0), (6.0, 10.0)]
+
+
+def _demo():
+    return demo_spar(nw_freqs=(0.05, 0.4))
+
+
+def _sweep(**kw):
+    # n_iter=8: enough Borgman iterations that healthy demo designs
+    # classify ok at the default resid_tol (at 6 the residual sits right
+    # at 1e-3 and the telemetry honestly reports non-convergence)
+    kw.setdefault("n_iter", 8)
+    kw.setdefault("chunk_size", 2)
+    return sweep_mod.sweep(_demo(), AXES, STATES, **kw)
+
+
+@pytest.fixture
+def chunk_hook():
+    """Install a chunk-dispatch hook for the duration of one test."""
+    def install(hook):
+        sweep_mod._CHUNK_EXEC_HOOK = hook
+    yield install
+    sweep_mod._CHUNK_EXEC_HOOK = None
+
+
+# ---------------------------------------------------------------------------
+# host-side units: classification, isolation runner, report
+# ---------------------------------------------------------------------------
+
+
+def test_classify_health_severity_order():
+    h = SolveHealth(
+        resid=np.array([1e-6, 5e-2, 1e-6, np.nan, 5e-2]),
+        cond=np.array([1e-2, 1e-2, 1e-14, 1e-2, 1e-14]),
+        nonfinite=np.array([False, False, False, True, True]),
+        n_fallback=np.zeros(5, np.int32))
+    st = classify_health(h, resid_tol=1e-3, cond_tol=1e-10)
+    assert st.dtype == np.int8
+    assert st.tolist() == [STATUS_OK, STATUS_NONCONV, STATUS_ILLCOND,
+                           STATUS_NAN, STATUS_NAN]
+    # worst-over-cases reduction relies on the severity ordering
+    assert reduce_design_status(st.reshape(1, 5)).tolist() == [STATUS_NAN]
+    assert status_name(STATUS_QUARANTINED) == "quarantined"
+
+
+def test_run_isolated_bisects_to_exact_poison():
+    poison = {3, 5}
+    calls = []
+
+    def run(idx):
+        calls.append(list(idx))
+        if poison & set(int(i) for i in idx):
+            raise RuntimeError("boom")
+        return {"x": np.asarray(idx, dtype=float) * 10.0,
+                "y": np.ones((len(idx), 2))}
+
+    idx = np.arange(8)
+    with pytest.warns(RuntimeWarning, match="quarantined"):
+        merged, quarantined = run_isolated(run, idx, retries=1)
+    assert quarantined.tolist() == [i in poison for i in range(8)]
+    ok = ~quarantined
+    np.testing.assert_array_equal(merged["x"][ok], idx[ok] * 10.0)
+    assert np.isnan(merged["x"][quarantined]).all()
+    assert merged["y"].shape == (8, 2)
+    # the full chunk is retried exactly once before bisection starts
+    assert calls[0] == calls[1] == list(range(8))
+
+
+def test_run_isolated_all_poison_returns_none():
+    def run(idx):
+        raise ValueError("always")
+
+    with pytest.warns(RuntimeWarning):
+        merged, quarantined = run_isolated(run, np.arange(2), retries=0)
+    assert merged is None
+    assert quarantined.all()
+
+
+def test_report_contents_and_format():
+    status = np.array([0, 4, 3, 0], dtype=np.int8)
+    combos = [(1.0,), (2.0,), (3.0,), (4.0,)]
+    rep = build_report(status, combos=combos, axes=[("a.b", [1, 2, 3, 4])],
+                       health={"resid": np.array([1e-5, np.nan, 2e-2, 1e-6]),
+                               "cond": np.array([0.1, np.nan, 1e-13, 0.2])})
+    assert rep["n_designs"] == 4 and not rep["all_ok"]
+    assert rep["quarantined"] == [1]
+    assert rep["failed"] == [1, 2]
+    assert rep["counts"]["quarantined"] == 1 and rep["counts"]["nan"] == 1
+    assert rep["failed_combos"][1] == {"a.b": 2.0}
+    text = format_report(rep)
+    assert "2/4 designs ok" in text and "design 1: quarantined" in text
+    # an all-ok report is one line
+    ok_rep = build_report(np.zeros(4, np.int8))
+    assert format_report(ok_rep) == "sweep health: 4/4 designs ok"
+
+
+# ---------------------------------------------------------------------------
+# fault injection through the sweep chunk loop
+# ---------------------------------------------------------------------------
+
+
+def test_raising_chunk_quarantines_exact_design(chunk_hook):
+    poison = 1
+
+    def hook(idx, dispatch):
+        if (np.asarray(idx) == poison).any():
+            raise RuntimeError("injected chunk fault")
+        return dispatch(idx)
+
+    chunk_hook(hook)
+    with pytest.warns(RuntimeWarning, match="isolating faults"):
+        out = _sweep()
+
+    status = out["status"]
+    assert status.dtype == np.int8
+    assert status[poison] == STATUS_QUARANTINED
+    ok = status == STATUS_OK
+    assert ok.tolist() == [i != poison for i in range(4)]
+    # healthy designs all computed, quarantined row stays NaN
+    assert np.isfinite(out["motion_std"][ok]).all()
+    assert np.isnan(out["motion_std"][poison]).all()
+    assert out["report"]["quarantined"] == [poison]
+    assert not out["report"]["all_ok"]
+
+
+def test_nan_design_flagged_not_ok(chunk_hook):
+    nan_design = 2
+
+    def hook(idx, dispatch):
+        std, a_std, pr, hb = dispatch(idx)
+        std = np.asarray(std).copy()
+        std[np.asarray(idx) == nan_design] = np.nan
+        return std, a_std, pr, hb
+
+    chunk_hook(hook)
+    out = _sweep()
+    status = out["status"]
+    assert status[nan_design] == STATUS_NAN
+    ok = status == STATUS_OK
+    assert ok.sum() == 3
+    # acceptance: no status-ok entry contains NaN
+    assert np.isfinite(out["motion_std"][ok]).all()
+    assert np.isfinite(out["AxRNA_std"][ok]).all()
+    assert out["report"]["counts"]["nan"] == 1
+
+
+def test_checkpoint_resume_preserves_quarantine(tmp_path, chunk_hook):
+    ckpt = str(tmp_path / "sweep.npz")
+    poison = 1
+
+    def hook(idx, dispatch):
+        if (np.asarray(idx) == poison).any():
+            raise RuntimeError("injected chunk fault")
+        return dispatch(idx)
+
+    chunk_hook(hook)
+    with pytest.warns(RuntimeWarning):
+        out1 = _sweep(checkpoint=ckpt)
+    assert out1["status"][poison] == STATUS_QUARANTINED
+
+    # resume: every design is done (computed or given up) -> no chunk
+    # must execute, and the quarantine mark must survive the round trip
+    def explode(idx, dispatch):
+        raise AssertionError("resume must not re-execute chunks")
+
+    chunk_hook(explode)
+    out2 = _sweep(checkpoint=ckpt)
+    np.testing.assert_array_equal(out2["status"], out1["status"])
+    np.testing.assert_allclose(out2["motion_std"], out1["motion_std"])
+    assert out2["report"]["quarantined"] == [poison]
+
+
+def test_corrupt_checkpoint_warns_and_starts_fresh(tmp_path):
+    ckpt = tmp_path / "sweep.npz"
+    ckpt.write_bytes(b"this is not an npz archive")
+    with pytest.warns(RuntimeWarning, match="unreadable"):
+        out = _sweep(checkpoint=str(ckpt))
+    assert (out["status"] == STATUS_OK).all()
+    assert np.isfinite(out["motion_std"]).all()
+    # and the sweep rewrote a valid checkpoint over the corpse
+    with np.load(str(ckpt)) as dat:
+        assert dat["done"].all() and "status" in dat
+
+
+def test_old_schema_checkpoint_resumes_all_ok(tmp_path):
+    ckpt = str(tmp_path / "sweep.npz")
+    out1 = _sweep(checkpoint=ckpt)
+    with np.load(ckpt) as dat:
+        old = {k: dat[k] for k in dat.files
+               if k not in ("status", "health_resid", "health_cond")}
+    np.savez(ckpt, **old)  # pre-status schema
+
+    out2 = _sweep(checkpoint=ckpt)
+    # already-done designs from an old checkpoint are treated as ok
+    assert (out2["status"] == STATUS_OK).all()
+    assert out2["report"]["all_ok"]
+    np.testing.assert_allclose(out2["motion_std"], out1["motion_std"])
+
+
+def test_health_off_matches_and_skips_telemetry():
+    out_on = _sweep()
+    out_off = _sweep(health=False)
+    np.testing.assert_allclose(out_off["motion_std"], out_on["motion_std"],
+                               rtol=2e-5)
+    # status still exists (finiteness-only classification), telemetry NaN
+    assert (out_off["status"] == STATUS_OK).all()
+    assert np.isnan(out_off["health"]["resid"]).all()
+    assert np.isfinite(out_on["health"]["resid"]).all()
+    assert np.isfinite(out_on["health"]["cond"]).all()
+
+
+@pytest.mark.sentinel
+def test_health_sweep_warm_run_no_recompile():
+    """The health channel rides the existing executables: a repeat sweep
+    (memoized programs) and the quarantine bisection (same padded chunk
+    shape) must trigger zero XLA compiles."""
+    from raft_tpu.analysis.recompile import RecompileSentinel
+
+    _sweep()  # warm: compiles + memoizes the chunk executables
+    with RecompileSentinel() as s:
+        snap = s.snapshot()
+        out = _sweep()
+        s.assert_no_recompile(snap, "warm health sweep")
+    assert (out["status"] == STATUS_OK).all()
+
+    poison = 3
+
+    def hook(idx, dispatch):
+        if (np.asarray(idx) == poison).any():
+            raise RuntimeError("injected")
+        return dispatch(idx)
+
+    sweep_mod._CHUNK_EXEC_HOOK = hook
+    try:
+        with RecompileSentinel() as s:
+            snap = s.snapshot()
+            with pytest.warns(RuntimeWarning):
+                out = _sweep()
+            s.assert_no_recompile(snap, "bisecting sweep")
+    finally:
+        sweep_mod._CHUNK_EXEC_HOOK = None
+    assert out["status"][poison] == STATUS_QUARANTINED

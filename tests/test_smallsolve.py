@@ -45,6 +45,64 @@ def test_pallas_interpret_matches_jnp():
     np.testing.assert_allclose(np.asarray(xi1), np.asarray(xi0), atol=1e-10)
 
 
+def test_cond_tracking_near_singular():
+    """Conditioning signal: healthy pivots ~O(1) ratio, a near-singular
+    yaw row (zero-stiffness mooring at ~1e-7 scale) drives the ratio to
+    ~1e-7 while the solution still matches jnp.linalg.solve within the
+    accuracy the conditioning allows — both jnp and Pallas (interpret)
+    elimination paths, since both record the same pivot magnitudes."""
+    rng = np.random.default_rng(7)
+    B = 64
+    Z, F = _random_systems(rng, B, m=2)
+    # scale the yaw row/column of half the batch down to ~1e-7: the
+    # pivot-magnitude ratio collapses but the matrix stays invertible
+    scale = 1e-7
+    Z[::2, 5, :] *= scale
+    Z[::2, :, 5] *= scale
+    ref = np.linalg.solve(Z, F)
+
+    Zt = jnp.asarray(Z.transpose(1, 2, 0))
+    Ft = jnp.asarray(F.transpose(1, 2, 0))
+    args = (jnp.real(Zt), jnp.imag(Zt), jnp.real(Ft), jnp.imag(Ft))
+
+    xr, xi, cond = smallsolve.solve_batchlast_jnp_cond(*args)
+    got = (np.asarray(xr) + 1j * np.asarray(xi)).transpose(2, 0, 1)
+    # the sick systems lose ~7 digits by construction; compare against
+    # the dense reference at a tolerance the conditioning supports
+    np.testing.assert_allclose(got, ref, rtol=1e-5, atol=1e-5)
+    cond = np.asarray(cond)
+    assert cond.shape == (B,)
+    assert np.all(cond[::2] < 1e-5), "near-singular lanes must flag"
+    assert np.all(cond[1::2] > 1e-3), "healthy lanes must not flag"
+    # the flag separates the two populations by orders of magnitude
+    assert cond[::2].max() < 1e-2 * cond[1::2].min()
+
+    xr2, xi2, cond2 = smallsolve.solve_batchlast_pallas(
+        *args, interpret=True, with_cond=True)
+    got2 = (np.asarray(xr2) + 1j * np.asarray(xi2)).transpose(2, 0, 1)
+    np.testing.assert_allclose(got2, got, rtol=1e-6, atol=1e-6)
+    np.testing.assert_allclose(np.asarray(cond2), cond, rtol=1e-5)
+
+    # with_cond=False stays the seed-identical two-output signature
+    xr3, xi3 = smallsolve.solve_batchlast_pallas(*args, interpret=True)
+    np.testing.assert_allclose(np.asarray(xr3), np.asarray(xr),
+                               rtol=1e-6, atol=1e-8)
+
+
+def test_impedance_multi_cond_matches_multi():
+    rng = np.random.default_rng(8)
+    nw, nH = 24, 2
+    Z, _ = _random_systems(rng, nw)
+    Fh = rng.normal(size=(nH, 6, nw)) + 1j * rng.normal(size=(nH, 6, nw))
+
+    base = np.asarray(smallsolve.solve_impedance_multi(jnp.asarray(Z), jnp.asarray(Fh)))
+    xh, cond = smallsolve.solve_impedance_multi_cond(jnp.asarray(Z), jnp.asarray(Fh))
+    np.testing.assert_allclose(np.asarray(xh), base, rtol=1e-12, atol=1e-12)
+    cond = np.asarray(cond)
+    assert cond.shape == (nw,)
+    assert np.all((cond > 0) & (cond <= 1.0))
+
+
 def test_impedance_wrappers():
     rng = np.random.default_rng(2)
     nw, nH = 40, 3
