@@ -35,7 +35,7 @@ def main():
             jax.config.update("jax_platforms", platforms + ",cpu")
     except Exception:
         pass
-    from raft_tpu.config import enable_compilation_cache
+    from raft_tpu.config import enable_compilation_cache, smallsolve_mode
     from raft_tpu.sweep import sweep
 
     # persistent compile cache: a cold process deserializes the sweep
@@ -83,14 +83,26 @@ def main():
         # this is probe-parse + stacking + device runtime); per-phase
         # breakdown via raft_tpu.profiling gives the auditable split
         from raft_tpu import profiling
+        from raft_tpu.analysis.recompile import RecompileSentinel
 
         profiling.reset()
         t0 = time.perf_counter()
-        out2 = sweep(design, axes, states, n_iter=15, device=accel, wind=wind,
-                     chunk_size=250)
+        # the sentinel counts XLA backend compiles during the repeat
+        # sweep: the warm path must be compile-free (executor acceptance
+        # gate) — any nonzero count here is cache-key churn
+        with RecompileSentinel() as sentinel:
+            out2 = sweep(design, axes, states, n_iter=15, device=accel,
+                         wind=wind, chunk_size=250)
         dt_warm = time.perf_counter() - t0
         phases = profiling.report()
         chunks_s = phases.get("sweep/chunks", float("nan"))
+        # chunk-loop split: the executor's per-stage phases nested under
+        # sweep/chunks (gather = on-device chunk selection, compute =
+        # executable dispatch, fetch = device->host, commit = host
+        # store; isolate appears only when a chunk faulted)
+        chunk_split = {k.split("/", 2)[2]: round(v, 3)
+                       for k, v in phases.items()
+                       if k.startswith("sweep/chunks/")}
 
         # device-solver evidence: the fused batch-last 6x6 Gauss-Jordan at
         # the sweep's per-chunk volume (250 designs x 12 cases x 200 w),
@@ -136,9 +148,19 @@ def main():
                                 for k, v in phases.items()},
             "designs_per_sec_execution": (round(n_designs / chunks_s, 1)
                                           if chunks_s == chunks_s else None),
+            # per-stage split of the warm chunk loop (s); see
+            # docs/performance.md for what each stage covers
+            "chunk_split_s": chunk_split,
+            # XLA backend compiles during the repeat sweep (must be 0:
+            # warm sweeps run entirely from cached executables)
+            "repeat_xla_compiles": sentinel.backend_compiles,
             # fused batch-last 6x6x200 complex Gauss-Jordan at per-chunk
             # volume (3000 cases), per solver path on this chip [ms]
             "smallsolve_ms": solver_ms,
+            # autotuned smallsolve path decisions made during the sweep
+            # (RAFT_TPU_SMALLSOLVE mode + per-size winner incl. block)
+            "smallsolve_mode": smallsolve_mode(),
+            "smallsolve_tuning": ss.tuning_report(),
         },
     }
     print(json.dumps(result))

@@ -54,6 +54,10 @@ def pytest_configure(config):
     config.addinivalue_line(
         "markers", "sentinel: deterministic compile-count tests (run in "
                    "the CI lint job)")
+    config.addinivalue_line(
+        "markers", "slow: long-running tests (real-timing autotune, "
+                   "large grids) excluded from the tier-1 `-m 'not "
+                   "slow'` run")
     if config.getoption("--recompile-sentinel"):
         from .recompile import RecompileSentinel
 

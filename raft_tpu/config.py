@@ -57,6 +57,66 @@ def health_config(overrides=None) -> dict:
     return cfg
 
 
+# ---------------------------------------------------------------------------
+# sweep chunk executor (raft_tpu.parallel.executor)
+# ---------------------------------------------------------------------------
+
+# Defaults for the device-resident pipelined chunk executor (see
+# docs/performance.md).  `resident` keeps the packed stacked variant
+# batch on the device for the whole sweep and selects each chunk with a
+# jitted on-device gather (OFF falls back to per-chunk host row packing
+# + transfer — the pre-executor behavior, bit-identical results);
+# `pipeline_depth` bounds how many dispatched chunks may be in flight
+# before the oldest is fetched/committed (1 = fully synchronous).
+# Environment overrides: RAFT_TPU_RESIDENT=0 disables the resident
+# path, RAFT_TPU_PIPELINE=<n> sets the depth.  Neither knob changes any
+# traced program: results are bit-identical across all settings.
+EXECUTOR_DEFAULTS = {
+    "resident": True,
+    "pipeline_depth": 2,
+}
+
+
+def executor_config(overrides=None) -> dict:
+    """Effective chunk-executor configuration: defaults, then
+    environment, then explicit ``overrides``."""
+    import os
+
+    cfg = dict(EXECUTOR_DEFAULTS)
+    env = os.environ.get("RAFT_TPU_RESIDENT")
+    if env is not None:
+        cfg["resident"] = env not in ("0", "false", "")
+    env = os.environ.get("RAFT_TPU_PIPELINE")
+    if env is not None:
+        cfg["pipeline_depth"] = max(1, int(env))
+    if overrides:
+        unknown = set(overrides) - set(cfg)
+        if unknown:
+            raise ValueError(f"unknown executor config key(s): {sorted(unknown)}")
+        cfg.update(overrides)
+    return cfg
+
+
+# Solver-path selection for the batched 6x6 impedance solves
+# (raft_tpu.parallel.smallsolve): 'auto' benchmarks the Pallas kernel
+# against the plain-jnp elimination at first use per (n, m, B, backend)
+# and caches the winner; 'jnp' / 'pallas' force a path (the forced
+# Pallas path runs in interpret mode off-TPU so the override stays
+# usable everywhere).  Override: RAFT_TPU_SMALLSOLVE={auto,jnp,pallas}.
+SMALLSOLVE_MODES = ("auto", "jnp", "pallas")
+
+
+def smallsolve_mode() -> str:
+    """Effective smallsolve path-selection mode."""
+    import os
+
+    mode = os.environ.get("RAFT_TPU_SMALLSOLVE", "auto").strip().lower() or "auto"
+    if mode not in SMALLSOLVE_MODES:
+        raise ValueError(
+            f"RAFT_TPU_SMALLSOLVE={mode!r}: expected one of {SMALLSOLVE_MODES}")
+    return mode
+
+
 def enable_compilation_cache(path: str | None = None) -> str | None:
     """Turn on JAX's persistent (on-disk) compilation cache.
 

@@ -94,11 +94,7 @@ def design_params(fowt, include_aero=True, device=None):
     designs in ONE compiled executable (the M2 sweep milestone).
     """
 
-    def put(x):
-        x = jnp.asarray(x)
-        return jax.device_put(x, device) if device is not None else x
-
-    nodes = {k2: (put(v) if not isinstance(v, bool) else v)
+    nodes = {k2: (jnp.asarray(v) if not isinstance(v, bool) else v)
              for k2, v in flatten_members(fowt).items()}
 
     # frequency-independent system matrices (raft_model.py:911-914)
@@ -113,13 +109,26 @@ def design_params(fowt, include_aero=True, device=None):
     mcf = nodes.pop("mcf")
     params = {
         "nodes": nodes,
-        "M": put(M_np),
-        "B": put(B_np),
-        "C": put(np.asarray(fowt.getStiffness())),
-        "prp": put(fowt.r6[:3]),
-        "w": put(fowt.w),
-        "k": put(fowt.k),
+        "M": jnp.asarray(M_np),
+        "B": jnp.asarray(B_np),
+        "C": jnp.asarray(np.asarray(fowt.getStiffness())),
+        "prp": jnp.asarray(fowt.r6[:3]),
+        "w": jnp.asarray(fowt.w),
+        "k": jnp.asarray(fowt.k),
     }
+    if device is not None:
+        # ONE batched transfer for the whole params tree: the old
+        # per-leaf device_put paid a host->device round trip for each of
+        # the ~hundred small node arrays (the dominant cost of the
+        # per-variant fallback path on a remote-chip runtime).  The
+        # python-bool node entries are trace-time flags, not arrays, so
+        # they're detached for the transfer and re-attached unchanged.
+        flags = {k: v for k, v in params["nodes"].items()
+                 if isinstance(v, bool)}
+        for k in flags:
+            del params["nodes"][k]
+        params = jax.device_put(params, device)
+        params["nodes"].update(flags)
     return params, {"mcf": mcf, "nw": fowt.nw, "depth": fowt.depth,
                     "rho": fowt.rho_water, "g": fowt.g}
 

@@ -1,0 +1,149 @@
+"""Device-resident pipelined chunk execution utilities for the sweep.
+
+The pre-executor chunk loop paid four host-side costs per chunk that
+have nothing to do with the physics (BENCH_r05: 2.89 s in
+``sweep/chunks`` vs <1 s of pure device runtime for the 1000x12 solve):
+
+1. host row packing (``pack_rows`` numpy fancy-index copies),
+2. a host->device transfer of the packed chunk,
+3. a synchronous ``np.asarray`` fetch of the previous chunk's results,
+4. an in-loop atomic ``np.savez`` checkpoint write.
+
+This module removes them (the resident-batch + async-pipeline executor
+shape of device-resident batched JAX frameworks — PAPERS.md: Fast
+Stokesian Dynamics, arXiv:2503.07847):
+
+* :func:`gather_rows` — the packed stacked variant batch is uploaded to
+  the device ONCE per sweep; each chunk is selected *on device* by this
+  jitted gather (a fused XLA dynamic-gather, no host copy, no H2D).
+  Module-level ``jax.jit`` keeps one stable cache entry per
+  (layout, shape) across repeat sweeps — zero recompiles on a warm
+  sweep.
+* :func:`start_host_fetch` — begins the device->host copies for every
+  leaf of a dispatched chunk's outputs immediately, so the D2H transfer
+  overlaps the next chunk's execution and the eventual ``np.asarray``
+  finds the bytes already on the host.
+* :class:`CheckpointWriter` — a coalescing background writer thread:
+  the hot loop submits state snapshots and never blocks on ``np.savez``;
+  rapid submissions coalesce (latest wins), ``close()`` guarantees the
+  final state is durably written before the sweep returns.
+
+Knobs (see :func:`raft_tpu.config.executor_config`):
+``RAFT_TPU_RESIDENT=0`` falls back to per-chunk host packing,
+``RAFT_TPU_PIPELINE=<n>`` sets the in-flight chunk bound.  Neither
+changes a traced program — results are bit-identical across settings.
+"""
+
+from __future__ import annotations
+
+import threading
+import warnings
+
+import jax
+
+__all__ = ["gather_rows", "start_host_fetch", "CheckpointWriter"]
+
+
+@jax.jit
+def gather_rows(resident, idx):
+    """On-device chunk selection: ``resident`` is the list of packed
+    [n_designs, width] per-dtype-group buffers living on the device for
+    the whole sweep, ``idx`` the padded [chunk] design-index array.
+    Returns the packed [chunk, width] buffers the chunk executable
+    consumes — freshly materialized, so the caller may donate them."""
+    return [r[idx] for r in resident]
+
+
+def start_host_fetch(tree):
+    """Start async device->host copies for every jax array leaf.
+
+    Called right after a chunk dispatch: the transfers run behind the
+    next chunk's execution, and the commit-side ``np.asarray`` calls
+    find the bytes already on the host instead of paying a synchronous
+    round trip each.  Non-jax leaves (a fault-injection hook returning
+    numpy rows) pass through untouched.  Returns ``tree`` unchanged.
+    """
+    for leaf in jax.tree_util.tree_leaves(tree):
+        fetch = getattr(leaf, "copy_to_host_async", None)
+        if fetch is not None:
+            fetch()
+    return tree
+
+
+class CheckpointWriter:
+    """Coalescing background checkpoint persistence.
+
+    ``submit(state)`` replaces any not-yet-written pending snapshot and
+    returns immediately; a daemon thread drains the latest snapshot
+    through ``write_fn`` (the atomic tmp-then-rename ``np.savez``).
+    Rapid chunk commits therefore cost one enqueue each but only as
+    many file writes as the disk keeps up with — the durability
+    guarantee is unchanged ("a crash loses at most the trailing
+    chunks"), the hot loop just stops paying for it.
+
+    ``close()`` flushes the final pending snapshot (so the on-disk file
+    always reflects the completed sweep), joins the thread, and warns —
+    never raises — if any write failed: the checkpoint exists to protect
+    the sweep, a full disk must not kill the results it was protecting.
+
+    ``state`` snapshots must be immutable from the submitter's side
+    (the sweep hands over copies of its result arrays): the writer
+    serializes them at an arbitrary later time.
+    """
+
+    def __init__(self, write_fn, name="raft-ckpt-writer"):
+        self._write = write_fn
+        self._cond = threading.Condition()
+        self._pending = None
+        self._closing = False
+        self._error = None
+        self._writes = 0
+        self._thread = threading.Thread(target=self._run, name=name,
+                                        daemon=True)
+        self._thread.start()
+
+    @property
+    def writes(self) -> int:
+        """Completed write count (attempts, including failed ones)."""
+        with self._cond:
+            return self._writes
+
+    def submit(self, state) -> None:
+        """Queue ``state`` as the newest snapshot (latest wins)."""
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("CheckpointWriter already closed")
+            self._pending = state
+            self._cond.notify()
+
+    def _run(self):
+        from .. import profiling
+
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closing:
+                    self._cond.wait()
+                state, self._pending = self._pending, None
+                if state is None:  # closing with nothing left to write
+                    return
+            try:
+                with profiling.phase("checkpoint_write"):
+                    self._write(state)
+            except Exception as e:  # noqa: BLE001 - surfaced at close()
+                with self._cond:
+                    self._error = e
+            with self._cond:
+                self._writes += 1
+
+    def close(self) -> None:
+        """Flush the final snapshot, stop the thread, warn on failure."""
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
+        self._thread.join()
+        if self._error is not None:
+            warnings.warn(
+                f"sweep: background checkpoint write failed "
+                f"({type(self._error).__name__}: {self._error}); the "
+                "on-disk checkpoint may lag the returned results",
+                RuntimeWarning)

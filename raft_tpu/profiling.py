@@ -10,58 +10,85 @@ Usage::
     profiling.summary()       # printable table, reset with reset()
 
 Timers nest (inner phases are recorded under "outer/inner") and are
-process-global, cheap (perf_counter), and inert unless read — analysis
-drivers wrap their stages unconditionally.  For kernel-level profiling
-use ``jax.profiler.trace`` around a phase; this module deliberately
-stays dependency-free so it also times host-side stages (YAML parsing,
-mesh generation, table builds) the JAX profiler cannot see.
+cheap (perf_counter) and inert unless read — analysis drivers wrap
+their stages unconditionally.  The accumulated times/counts are
+process-global (one report covers every thread), but the NESTING stack
+is thread-local: the sweep executor runs a background checkpoint-writer
+thread and compile workers whose phases must not splice themselves into
+the main thread's "sweep/..." hierarchy (a shared stack would both
+corrupt the names and pop other threads' frames).  Each thread's phases
+nest only within that thread.
+
+For kernel-level profiling use ``jax.profiler.trace`` around a phase;
+this module deliberately stays dependency-free so it also times
+host-side stages (YAML parsing, mesh generation, table builds) the JAX
+profiler cannot see.
 """
 
 from __future__ import annotations
 
 import contextlib
+import threading
 import time
 from collections import defaultdict
 
 _times: dict[str, float] = defaultdict(float)
 _counts: dict[str, int] = defaultdict(int)
-_stack: list[str] = []
+_lock = threading.Lock()
+_tls = threading.local()
+
+
+def _stack() -> list[str]:
+    """This thread's phase-nesting stack (created on first use)."""
+    s = getattr(_tls, "stack", None)
+    if s is None:
+        s = _tls.stack = []
+    return s
 
 
 @contextlib.contextmanager
 def phase(name: str):
     """Accumulate wall time under ``name`` (nested -> 'outer/inner')."""
-    full = "/".join(_stack + [name])
-    _stack.append(name)
+    stack = _stack()
+    full = "/".join(stack + [name])
+    stack.append(name)
     t0 = time.perf_counter()
     try:
         yield
     finally:
-        _stack.pop()
-        _times[full] += time.perf_counter() - t0
-        _counts[full] += 1
+        stack.pop()
+        dt = time.perf_counter() - t0
+        with _lock:
+            _times[full] += dt
+            _counts[full] += 1
 
 
 def report() -> dict[str, float]:
     """Accumulated seconds per phase."""
-    return dict(_times)
+    with _lock:
+        return dict(_times)
 
 
 def counts() -> dict[str, int]:
-    return dict(_counts)
+    with _lock:
+        return dict(_counts)
 
 
 def reset() -> None:
-    _times.clear()
-    _counts.clear()
+    with _lock:
+        _times.clear()
+        _counts.clear()
 
 
 def summary() -> str:
     """Aligned table of phases, call counts, and accumulated seconds."""
-    if not _times:
+    with _lock:
+        times = dict(_times)
+        cnt = dict(_counts)
+    if not times:
         return "(no phases recorded)"
-    width = max(len(k) for k in _times)
+    width = max(len(k) for k in times)
     lines = [f"{'phase':<{width}}  {'calls':>6}  {'seconds':>9}"]
-    for k in sorted(_times, key=_times.get, reverse=True):
-        lines.append(f"{k:<{width}}  {_counts[k]:>6}  {_times[k]:>9.3f}")
+    for k in sorted(times, key=times.get, reverse=True):
+        lines.append(f"{k:<{width}}  {cnt[k]:>6}  {times[k]:>9.3f}")
     return "\n".join(lines)

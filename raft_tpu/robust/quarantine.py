@@ -49,7 +49,7 @@ def _merge(parts, idx_parts, n_rows):
     return out
 
 
-def run_isolated(run, idx, retries=1, display=0, _depth=0):
+def run_isolated(run, idx, retries=1, display=0):
     """Execute ``run(idx)`` with fault isolation.
 
     Parameters
@@ -57,6 +57,13 @@ def run_isolated(run, idx, retries=1, display=0, _depth=0):
     run : callable(np.ndarray[int]) -> dict[str, np.ndarray]
         Executes the given design indices and returns result rows
         aligned with ``idx`` (leading axis ``len(idx)``).  May raise.
+        With the pipelined executor, dispatch is asynchronous: a poison
+        chunk often raises only at the device->host FETCH, so the sweep
+        routes both dispatch-time and fetch-time exceptions here — the
+        runner must (and does) treat "run returned but its rows are
+        unreadable" the same as "run raised".  ``run`` itself fetches
+        synchronously (np.asarray on its outputs), keeping that boundary
+        inside each isolated re-execution.
     idx : array of design indices (any length >= 1).
     retries : int
         Immediate re-runs of the SAME index set before bisecting
@@ -69,7 +76,19 @@ def run_isolated(run, idx, retries=1, display=0, _depth=0):
     (results, quarantined) where ``results`` is the merged row dict
     (NaN rows for quarantined designs; ``None`` if every design failed)
     and ``quarantined`` is a bool mask aligned with ``idx``.
+
+    The whole recursive isolation of one failing chunk is accumulated
+    under the "isolate" profiling phase (nested under the caller's
+    phase, e.g. "sweep/chunks/isolate"), so the bench's chunk-loop
+    split separates fault-recovery time from the healthy hot loop.
     """
+    from .. import profiling
+
+    with profiling.phase("isolate"):
+        return _run_isolated(run, idx, retries=retries, display=display)
+
+
+def _run_isolated(run, idx, retries=1, display=0, _depth=0):
     idx = np.asarray(idx)
     n = len(idx)
     last_err = None
@@ -96,8 +115,8 @@ def run_isolated(run, idx, retries=1, display=0, _depth=0):
     halves = [idx[:mid], idx[mid:]]
     parts, masks = [], []
     for half in halves:
-        res, mask = run_isolated(run, half, retries=0, display=display,
-                                 _depth=_depth + 1)
+        res, mask = _run_isolated(run, half, retries=0, display=display,
+                                  _depth=_depth + 1)
         parts.append(res)
         masks.append(mask)
     quarantined = np.concatenate(masks)

@@ -33,11 +33,14 @@ import jax.numpy as jnp
 
 from . import profiling
 from .analysis.contracts import shape_contract
-from .config import health_config
+from .config import executor_config, health_config
 from .core.model import Model
 from .ops import waves
-from .parallel.design_batch import (SweepAxisError, set_in_design,
-                                    stack_variants, variant_finite_mask)
+from .parallel.design_batch import (SweepAxisError, pack_rows, pack_spec,
+                                    set_in_design, stack_variants,
+                                    unpack_leaves, variant_finite_mask)
+from .parallel.executor import (CheckpointWriter, gather_rows,
+                                start_host_fetch)
 from .robust import (STATUS_NAN, STATUS_OK, STATUS_QUARANTINED, SolveHealth,
                      build_report, classify_health, format_report,
                      run_isolated)
@@ -74,58 +77,6 @@ def _design_hash(base_design):
 
 def _template_key(base_design, n_iter, with_aero):
     return (_design_hash(base_design), int(n_iter), bool(with_aero))
-
-
-def _pack_spec(stacked):
-    """Plan the flat transfer layout for a stacked leaf batch.
-
-    The stacked batch is a couple hundred small arrays; transferring them
-    leaf-by-leaf costs one host->device round trip each (~0.1 s over a
-    remote-chip tunnel, ~25 s per sweep).  Instead the leaves are packed
-    into ONE [n_designs, width] buffer per dtype group on the host and
-    unpacked with free reshapes inside the jitted chunk.
-
-    Returns ``[(dtype_str, [(leaf_idx, trailing_shape, size), ...]), ...]``
-    sorted by dtype for determinism.  Dtypes are canonicalized the same
-    way ``jnp.asarray`` would (f64 -> f32 unless x64 is enabled), so the
-    packed path is numerically identical to the per-leaf path.
-    """
-    from jax import dtypes as jdtypes
-
-    groups: dict = {}
-    for il, lf in enumerate(stacked):
-        dt = np.dtype(jdtypes.canonicalize_dtype(lf.dtype)).str
-        shape = lf.shape[1:]
-        size = int(np.prod(shape, dtype=np.int64)) if shape else 1
-        groups.setdefault(dt, []).append((il, shape, size))
-    return sorted(groups.items())
-
-
-def _pack_rows(stacked, spec, idx):
-    """Pack the selected design rows into one contiguous host buffer per
-    dtype group (numpy fancy-index copy; O(chunk bytes))."""
-    out = []
-    for dts, entries in spec:
-        buf = np.empty((len(idx), sum(s for _, _, s in entries)),
-                       dtype=np.dtype(dts))
-        off = 0
-        for il, shape, size in entries:
-            buf[:, off:off + size] = stacked[il][idx].reshape(len(idx), size)
-            off += size
-        out.append(buf)
-    return out
-
-
-def _unpack_leaves(packed, spec, n_leaves):
-    """Inverse of :func:`_pack_rows` inside jit: slice+reshape views, all
-    fused away by XLA."""
-    leaves = [None] * n_leaves
-    for arr, (dts, entries) in zip(packed, spec):
-        off = 0
-        for il, shape, size in entries:
-            leaves[il] = arr[:, off:off + size].reshape((arr.shape[0],) + shape)
-            off += size
-    return leaves
 
 
 def _design_case_mesh(devices, n_cases):
@@ -489,7 +440,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             print(f"sweep: falling back to per-variant model path ({e})")
 
     if stacked is not None:
-        spec = _pack_spec(stacked)
+        spec = pack_spec(stacked)
         n_leaves = len(stacked)
         zetas, betas = _sea_state_waves(fowt, sea_states)
 
@@ -603,7 +554,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
 
             def _leaves(packed):
                 return jax.tree_util.tree_unflatten(
-                    treedef, _unpack_leaves(packed, spec, n_leaves))
+                    treedef, unpack_leaves(packed, spec, n_leaves))
 
             def _postB(out, zh):
                 """Metrics (+ health) from the double-vmapped solve."""
@@ -656,7 +607,18 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                     return _postB(out, sel["zh"][av])
 
             if mesh is None:
-                jA, jB = jax.jit(partA), jax.jit(partB)
+                # donate the per-chunk intermediates: argument 0 of A is
+                # the gathered/packed chunk buffers (produced fresh per
+                # chunk by the on-device gather or the host pack) and
+                # argument 0 of B is A's params output — neither is read
+                # again after the call, so XLA reuses their device memory
+                # for outputs instead of allocating a second chunk's
+                # worth.  The shared inputs (zetas/betas/variant tables/
+                # resident batch) are NOT in argnum 0 and stay intact.
+                # Mesh path: no donation — keep the sharded programs'
+                # buffer story simple.
+                jA = jax.jit(partA, donate_argnums=(0,))
+                jB = jax.jit(partB, donate_argnums=(0,))
                 sds = ((lambda sh, dt: jax.ShapeDtypeStruct(sh, dt))
                        if device is None else
                        (lambda sh, dt, _s=jax.sharding.SingleDeviceSharding(device):
@@ -702,7 +664,18 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             # and absorbing it here overlaps it with the main thread's
             # aero-table work (the garbage outputs are discarded — a
             # zero-geometry solve just produces NaNs in dead buffers).
-            lA = jA.lower(*argsA)
+            # donation is best-effort: XLA aliases only the donated
+            # buffers whose sizes match an output, and warns about the
+            # rest on every lowering.  That partial coverage is the
+            # expected steady state here (params has many more leaves
+            # than B has outputs), not a bug worth a per-sweep warning.
+            def _lower(j, *args):
+                with warnings.catch_warnings():
+                    warnings.filterwarnings(
+                        "ignore", message="Some donated buffers were not usable")
+                    return j.lower(*args)
+
+            lA = _lower(jA, *argsA)
             built: dict = {}
             warm_failures: dict = {}
 
@@ -780,7 +753,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                         _zeros_like_sds(argsB[3], put_r),
                         put_d(np.zeros((chunk_size,), np.int32)))
 
-            lB = jB.lower(*argsB)
+            lB = _lower(jB, *argsB)
             tB = threading.Thread(target=_compile, args=("B", lB, dummyB),
                                   daemon=True)
             tB.start()
@@ -862,15 +835,65 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         # finite garbage for them
         input_ok = variant_finite_mask(stacked)
 
+        # ---- device-resident executor state (parallel/executor.py).
+        # The whole packed variant batch is uploaded ONCE and each chunk
+        # is selected on-device by the jitted gather, replacing a
+        # per-chunk host fancy-index copy + H2D transfer with one fused
+        # device gather.  Cached in the template memo (keyed like the
+        # stack memo plus device placement), so a repeat sweep re-uploads
+        # nothing.  Disabled on the mesh path: a design-sharded gather by
+        # arbitrary global indices would need collectives, and the mesh
+        # path's per-chunk transfers are already split across chips.
+        ecfg = executor_config()
+        pipeline_depth = max(1, int(ecfg["pipeline_depth"]))
+        resident = None
+        if ecfg["resident"] and mesh is None:
+            rkey = (stack_key, place_sig) if stack_key is not None else None
+            entry = _TEMPLATE_MEMO.get(memo_key)
+            rcache = None
+            if (rkey is not None and entry is not None
+                    and entry.get("treedef") == treedef
+                    and entry.get("spec") == spec):
+                rcache = entry.setdefault("resident", {})
+                resident = rcache.get(rkey)
+            if resident is None:
+                with profiling.phase("sweep/resident_upload"):
+                    resident = [put_d(b) for b in
+                                pack_rows(stacked, spec, np.arange(n_designs))]
+                if rcache is not None:
+                    while len(rcache) >= 2:
+                        rcache.pop(next(iter(rcache)))
+                    rcache[rkey] = resident
+
+        # coalescing background checkpoint persistence: the chunk loop
+        # submits state snapshots and never blocks on np.savez; close()
+        # in the finally below guarantees the final (complete) state is
+        # on disk before sweep() returns, so resume semantics and the
+        # end-of-sweep file contents are exactly the synchronous path's.
+        ckpt_writer = None
+        if checkpoint:
+            ckpt_writer = CheckpointWriter(
+                lambda st: _save_checkpoint(checkpoint, sig, *st))
+
+        def _submit_ckpt():
+            # snapshot copies: the writer serializes at an arbitrary
+            # later time while the loop keeps mutating the originals
+            ckpt_writer.submit((results.copy(), done.copy(),
+                                {k: v.copy() for k, v in props.items()},
+                                nacelle_acc.copy(), status.copy(),
+                                health_resid.copy(), health_cond.copy()))
+
         with profiling.phase("sweep/chunks"):
-            # software-pipelined with bounded depth: chunk k+1's transfers
+            # software-pipelined with bounded depth: chunk k+1's gather
             # and executables are queued before chunk k's results are
             # fetched, hiding the host->device->host round trips behind
             # execution (which matters when the chip sits behind a network
-            # tunnel) — but never more than _PIPELINE chunks are in flight,
-            # so device memory stays bounded and per-chunk checkpoint
-            # commits lag at most one chunk behind dispatch.
-            _PIPELINE = 2
+            # tunnel) — but never more than `pipeline_depth` chunks are in
+            # flight, so device memory stays bounded and per-chunk
+            # checkpoint commits lag at most depth-1 chunks behind
+            # dispatch.  Depth 1 is fully synchronous; results are
+            # bit-identical at every depth (the traced programs and their
+            # execution order per design are unchanged).
             pending = []
 
             def _dispatch(idx):
@@ -882,28 +905,41 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 return dispatch(idx)
 
             def _dispatch_real(idx):
-                packed = [put_d(b) for b in _pack_rows(stacked, spec, idx)]
-                if mode == "plain":
-                    pr, params = cA(packed)
-                    outB = cB(params, zetas, betas)
-                elif mode == "aero":
-                    pr, params = cA(packed)
-                    outB = cB(params, zetas, betas, aero)
-                else:
-                    av_dev = put_d(aero_idx[idx])
-                    pr, params = cA(packed, sel_variants["rna"], av_dev)
-                    if mode == "sel":
-                        outB = cB(params, zetas, betas,
-                                  sel_variants["zh"], av_dev)
+                with profiling.phase("gather"):
+                    if resident is not None:
+                        # on-device chunk selection from the resident
+                        # batch (fresh output buffers -> donatable to A)
+                        packed = gather_rows(
+                            resident, put_d(np.asarray(idx, dtype=np.int32)))
                     else:
-                        outB = cB(params, zetas, betas,
-                                  {k: sel_variants[k] for k in ("A", "B", "zh")},
-                                  av_dev)
+                        # legacy path (RAFT_TPU_RESIDENT=0 / mesh): host
+                        # fancy-index pack + per-chunk transfer
+                        packed = [put_d(b) for b in pack_rows(stacked, spec, idx)]
+                with profiling.phase("compute"):
+                    if mode == "plain":
+                        pr, params = cA(packed)
+                        outB = cB(params, zetas, betas)
+                    elif mode == "aero":
+                        pr, params = cA(packed)
+                        outB = cB(params, zetas, betas, aero)
+                    else:
+                        av_dev = put_d(aero_idx[idx])
+                        pr, params = cA(packed, sel_variants["rna"], av_dev)
+                        if mode == "sel":
+                            outB = cB(params, zetas, betas,
+                                      sel_variants["zh"], av_dev)
+                        else:
+                            outB = cB(params, zetas, betas,
+                                      {k: sel_variants[k] for k in ("A", "B", "zh")},
+                                      av_dev)
                 if run_health:
                     std, a_std, hb = outB
                 else:
                     (std, a_std), hb = outB, None
-                return std, a_std, pr, hb
+                # kick off the device->host copies now: they overlap the
+                # next chunk's execution, and the commit-side np.asarray
+                # finds the bytes already on the host
+                return start_host_fetch((std, a_std, pr, hb))
 
             def _classify_rows(rows_idx, std_rows, a_std_rows, hb_rows):
                 """int8 per-design status for fetched numpy chunk rows."""
@@ -933,22 +969,22 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 status[rows_idx] = _classify_rows(rows_idx, std_rows,
                                                   a_std_rows, hb_rows)
                 done[rows_idx] = True
-                if checkpoint:
-                    _save_checkpoint(checkpoint, sig, results, done, props,
-                                     nacelle_acc, status, health_resid,
-                                     health_cond)
+                if ckpt_writer is not None:
+                    _submit_ckpt()
 
             def _commit(entry):
                 start, stop, n_real, std, a_std, pr, hb = entry
-                hb_rows = None
-                if hb is not None:
-                    hb_rows = {k: np.asarray(v)[:n_real]
-                               for k, v in hb._asdict().items()}
-                _store_rows(np.arange(start, stop),
-                            np.asarray(std)[:n_real],
-                            np.asarray(a_std)[:n_real],
-                            {k: np.asarray(pr[k])[:n_real] for k in props},
-                            hb_rows)
+                with profiling.phase("fetch"):
+                    hb_rows = None
+                    if hb is not None:
+                        hb_rows = {k: np.asarray(v)[:n_real]
+                                   for k, v in hb._asdict().items()}
+                    std_rows = np.asarray(std)[:n_real]
+                    a_std_rows = np.asarray(a_std)[:n_real]
+                    pr_rows = {k: np.asarray(pr[k])[:n_real] for k in props}
+                with profiling.phase("commit"):
+                    _store_rows(np.arange(start, stop), std_rows, a_std_rows,
+                                pr_rows, hb_rows)
                 if display:
                     print(f"sweep: designs {start+1}-{stop}/{n_designs} done")
 
@@ -994,10 +1030,8 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                                 hb_rows)
                 status[rows_idx[quarantined]] = STATUS_QUARANTINED
                 done[rows_idx] = True
-                if checkpoint:
-                    _save_checkpoint(checkpoint, sig, results, done, props,
-                                     nacelle_acc, status, health_resid,
-                                     health_cond)
+                if ckpt_writer is not None:
+                    _submit_ckpt()
                 if display:
                     print(f"sweep: designs {start+1}-{stop}/{n_designs} done "
                           f"({int(quarantined.sum())} quarantined)")
@@ -1011,27 +1045,35 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 except Exception as e:  # noqa: BLE001 - isolation boundary
                     _isolate(entry[0], entry[1], e)
 
-            for start in range(0, n_designs, chunk_size):
-                stop = min(start + chunk_size, n_designs)
-                if done[start:stop].all():
-                    continue
-                # pad a short final chunk by repeating the last design so
-                # every chunk shares one leading shape (a second XLA compile
-                # would cost more than the padded rows; padded results are
-                # discarded)
-                n_real = stop - start
-                idx = np.arange(start, start + chunk_size)
-                idx[n_real:] = stop - 1
-                try:
-                    entry = (start, stop, n_real) + _dispatch(idx)
-                except Exception as e:  # noqa: BLE001 - isolation boundary
-                    _isolate(start, stop, e)
-                    continue
-                pending.append(entry)
-                while len(pending) >= _PIPELINE:
-                    _safe_commit(pending.pop(0))
-            for entry in pending:
-                _safe_commit(entry)
+            try:
+                for start in range(0, n_designs, chunk_size):
+                    stop = min(start + chunk_size, n_designs)
+                    if done[start:stop].all():
+                        continue
+                    # pad a short final chunk by repeating the last design so
+                    # every chunk shares one leading shape (a second XLA compile
+                    # would cost more than the padded rows; padded results are
+                    # discarded)
+                    n_real = stop - start
+                    idx = np.arange(start, start + chunk_size)
+                    idx[n_real:] = stop - 1
+                    try:
+                        entry = (start, stop, n_real) + _dispatch(idx)
+                    except Exception as e:  # noqa: BLE001 - isolation boundary
+                        _isolate(start, stop, e)
+                        continue
+                    pending.append(entry)
+                    while len(pending) >= pipeline_depth:
+                        _safe_commit(pending.pop(0))
+                for entry in pending:
+                    _safe_commit(entry)
+            finally:
+                # flush the final checkpoint snapshot before returning
+                # (or before propagating an abort — the on-disk file then
+                # reflects every committed chunk, same as the old
+                # synchronous saves)
+                if ckpt_writer is not None:
+                    ckpt_writer.close()
         return _finalize()
 
     # ----- fallback: per-variant model compile, batched device solve -----

@@ -1,0 +1,196 @@
+"""Device-resident pipelined chunk executor (raft_tpu.parallel.executor).
+
+The executor's contract is that its knobs change SCHEDULING, never
+results: the resident on-device gather vs legacy host packing, pipeline
+depth 1 vs 3, and fault isolation through the resident path must all
+produce bit-identical sweep outputs from the same compiled executables,
+with zero extra XLA compiles once the executables are warm.  The
+coalescing checkpoint writer must preserve the synchronous path's
+durability contract (final state on disk when sweep() returns) without
+the hot loop ever blocking on np.savez.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+from raft_tpu import profiling
+from raft_tpu import sweep as sweep_mod
+from raft_tpu.config import executor_config
+from raft_tpu.designs import demo_spar
+from raft_tpu.parallel.executor import CheckpointWriter
+from raft_tpu.robust import STATUS_OK, STATUS_QUARANTINED
+
+AXES = [("platform.members.0.d",
+         [[9.4, 9.4, 6.5, 6.5], [10.0, 10.0, 6.5, 6.5],
+          [10.5, 10.5, 6.5, 6.5], [11.0, 11.0, 6.5, 6.5]])]
+STATES = [(4.0, 8.0), (6.0, 10.0)]
+
+
+def _sweep(**kw):
+    kw.setdefault("n_iter", 8)
+    kw.setdefault("chunk_size", 2)
+    return sweep_mod.sweep(demo_spar(nw_freqs=(0.05, 0.4)), AXES, STATES, **kw)
+
+
+def _assert_same_results(a, b):
+    np.testing.assert_array_equal(a["motion_std"], b["motion_std"])
+    np.testing.assert_array_equal(a["AxRNA_std"], b["AxRNA_std"])
+    np.testing.assert_array_equal(a["status"], b["status"])
+    for k in ("mass", "displacement", "GMT"):
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+# ---------------------------------------------------------------------------
+# config knobs
+# ---------------------------------------------------------------------------
+
+
+def test_executor_config_defaults_and_env(monkeypatch):
+    cfg = executor_config()
+    assert cfg == {"resident": True, "pipeline_depth": 2}
+    monkeypatch.setenv("RAFT_TPU_RESIDENT", "0")
+    monkeypatch.setenv("RAFT_TPU_PIPELINE", "5")
+    cfg = executor_config()
+    assert cfg["resident"] is False and cfg["pipeline_depth"] == 5
+    # depth floors at 1 (0 would deadlock the commit loop)
+    monkeypatch.setenv("RAFT_TPU_PIPELINE", "0")
+    assert executor_config()["pipeline_depth"] == 1
+    with pytest.raises(ValueError, match="unknown executor config"):
+        executor_config({"residnt": True})
+
+
+# ---------------------------------------------------------------------------
+# scheduling knobs never change results (bit-identity)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.sentinel
+def test_executor_variants_bit_identical_no_recompile(monkeypatch):
+    """Resident vs legacy packing, pipeline depth 1 vs 3, and a
+    fault-injected chunk must (a) reuse the warm executables with ZERO
+    new XLA compiles and (b) reproduce the baseline bit-for-bit (the
+    quarantined row excepted — it is NaN by contract)."""
+    from raft_tpu.analysis.recompile import RecompileSentinel
+
+    base = _sweep()  # warm: compiles + memoizes executables AND gather
+    assert (base["status"] == STATUS_OK).all()
+
+    with RecompileSentinel() as s:
+        snap = s.snapshot()
+
+        monkeypatch.setenv("RAFT_TPU_RESIDENT", "0")
+        legacy = _sweep()
+        s.assert_no_recompile(snap, "legacy-packing sweep")
+        _assert_same_results(base, legacy)
+
+        monkeypatch.delenv("RAFT_TPU_RESIDENT")
+        monkeypatch.setenv("RAFT_TPU_PIPELINE", "1")
+        depth1 = _sweep()
+        s.assert_no_recompile(snap, "depth-1 sweep")
+        _assert_same_results(base, depth1)
+
+        monkeypatch.setenv("RAFT_TPU_PIPELINE", "3")
+        depth3 = _sweep()
+        s.assert_no_recompile(snap, "depth-3 sweep")
+        _assert_same_results(base, depth3)
+
+        # fault injection through the resident gather: the bisection
+        # re-runs ride the same padded chunk executables
+        poison = 1
+
+        def hook(idx, dispatch):
+            if (np.asarray(idx) == poison).any():
+                raise RuntimeError("injected chunk fault")
+            return dispatch(idx)
+
+        monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", hook)
+        with pytest.warns(RuntimeWarning, match="isolating faults"):
+            faulted = _sweep()
+        s.assert_no_recompile(snap, "fault-isolating sweep")
+
+    assert faulted["status"][poison] == STATUS_QUARANTINED
+    ok = faulted["status"] == STATUS_OK
+    assert ok.tolist() == [i != poison for i in range(4)]
+    np.testing.assert_array_equal(faulted["motion_std"][ok],
+                                  base["motion_std"][ok])
+    assert np.isnan(faulted["motion_std"][poison]).all()
+
+
+def test_chunk_phase_split_recorded():
+    """The executor's per-stage phases land under sweep/chunks (what
+    bench.py reports as chunk_split_s)."""
+    _sweep()  # warm so the phase times reflect the steady state
+    profiling.reset()
+    _sweep()
+    rep = profiling.report()
+    for stage in ("gather", "compute", "fetch", "commit"):
+        assert f"sweep/chunks/{stage}" in rep, (stage, sorted(rep))
+    assert "sweep/chunks/isolate" not in rep  # healthy sweep
+    profiling.reset()
+
+
+def test_resident_checkpoint_final_state_complete(tmp_path, monkeypatch):
+    """With the background writer and a deep pipeline, the on-disk
+    checkpoint at sweep() return still holds the COMPLETE final state
+    (close() flushes the last snapshot before the sweep returns)."""
+    monkeypatch.setenv("RAFT_TPU_PIPELINE", "3")
+    ckpt = str(tmp_path / "sweep.npz")
+    out = _sweep(checkpoint=ckpt)
+    with np.load(ckpt) as dat:
+        assert dat["done"].all()
+        np.testing.assert_array_equal(dat["motion_std"], out["motion_std"])
+        np.testing.assert_array_equal(dat["status"], out["status"])
+
+
+# ---------------------------------------------------------------------------
+# CheckpointWriter unit behavior
+# ---------------------------------------------------------------------------
+
+
+def test_checkpoint_writer_coalesces_latest_wins():
+    """Rapid submissions while a write is in flight coalesce: only the
+    in-flight state and the LAST submitted state reach the disk."""
+    written = []
+    first_in = threading.Event()
+    release = threading.Event()
+
+    def write(state):
+        if state == 0:
+            first_in.set()
+            assert release.wait(timeout=5.0)
+        written.append(state)
+
+    w = CheckpointWriter(write)
+    w.submit(0)
+    assert first_in.wait(timeout=5.0)
+    for i in range(1, 50):  # all queued while write(0) is blocked
+        w.submit(i)
+    release.set()
+    w.close()
+    assert written == [0, 49]
+    assert w.writes == 2
+
+
+def test_checkpoint_writer_flushes_pending_on_close():
+    written = []
+    w = CheckpointWriter(written.append)
+    w.submit("final")
+    w.close()
+    assert written[-1] == "final"
+    with pytest.raises(RuntimeError, match="closed"):
+        w.submit("late")
+
+
+def test_checkpoint_writer_error_warns_not_raises():
+    """A failing write (disk full) must not kill the sweep it protects:
+    surfaced as ONE RuntimeWarning at close, never an exception."""
+    def write(state):
+        raise OSError("disk full")
+
+    w = CheckpointWriter(write)
+    w.submit(1)
+    w.submit(2)
+    with pytest.warns(RuntimeWarning, match="checkpoint write failed"):
+        w.close()

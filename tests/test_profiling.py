@@ -1,0 +1,81 @@
+"""Per-phase timing: nesting, accumulation, and thread isolation.
+
+The sweep's pipelined executor runs a background checkpoint-writer
+thread (and AOT compile workers) that record their own phases; the
+nesting stack must be thread-local or concurrent phases splice into the
+main thread's hierarchy and pop each other's frames.
+"""
+
+import threading
+
+from raft_tpu import profiling
+
+
+def test_nested_phases_accumulate():
+    profiling.reset()
+    with profiling.phase("outer"):
+        with profiling.phase("inner"):
+            pass
+        with profiling.phase("inner"):
+            pass
+    rep = profiling.report()
+    assert set(rep) == {"outer", "outer/inner"}
+    assert profiling.counts()["outer/inner"] == 2
+    assert rep["outer"] >= rep["outer/inner"] >= 0.0
+    profiling.reset()
+    assert profiling.report() == {}
+
+
+def test_phase_stack_is_thread_local():
+    """A phase opened on a worker thread must not become the prefix of a
+    main-thread phase that happens to run inside its time window (the
+    old process-global stack recorded 'a/b' here and popped frames
+    across threads)."""
+    profiling.reset()
+    in_a = threading.Event()
+    release = threading.Event()
+
+    def worker():
+        with profiling.phase("writer_phase"):
+            in_a.set()
+            release.wait(timeout=5.0)
+
+    t = threading.Thread(target=worker, daemon=True)
+    t.start()
+    assert in_a.wait(timeout=5.0)
+    # main thread enters a phase while the worker's phase is open
+    with profiling.phase("main_phase"):
+        with profiling.phase("sub"):
+            pass
+    release.set()
+    t.join(timeout=5.0)
+
+    keys = set(profiling.report())
+    assert keys == {"writer_phase", "main_phase", "main_phase/sub"}
+    profiling.reset()
+
+
+def test_concurrent_phases_do_not_corrupt_counts():
+    profiling.reset()
+    n_threads, n_iter = 4, 50
+    barrier = threading.Barrier(n_threads)
+
+    def worker():
+        barrier.wait(timeout=5.0)
+        for _ in range(n_iter):
+            with profiling.phase("hot"):
+                with profiling.phase("in"):
+                    pass
+
+    threads = [threading.Thread(target=worker, daemon=True)
+               for _ in range(n_threads)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=10.0)
+
+    cnt = profiling.counts()
+    assert cnt["hot"] == n_threads * n_iter
+    assert cnt["hot/in"] == n_threads * n_iter
+    assert "in" not in cnt  # nesting never detached mid-flight
+    profiling.reset()

@@ -121,3 +121,127 @@ def test_impedance_wrappers():
 
     Zinv = np.asarray(smallsolve.inverse_impedance(jnp.asarray(Z)))
     np.testing.assert_allclose(Zinv, np.linalg.inv(Z), rtol=1e-9, atol=1e-9)
+
+
+# ---------------------------------------------------------------------------
+# path selection + autotune (RAFT_TPU_SMALLSOLVE)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def tune_cache(monkeypatch):
+    """A fresh autotune cache for the duration of one test."""
+    cache: dict = {}
+    monkeypatch.setattr(smallsolve, "_TUNE_CACHE", cache)
+    return cache
+
+
+def test_mode_override_parity(monkeypatch, tune_cache):
+    """All three RAFT_TPU_SMALLSOLVE modes produce the same solution
+    (the forced Pallas path runs in interpret mode off-TPU)."""
+    rng = np.random.default_rng(3)
+    nw, nH = 32, 2
+    Z, _ = _random_systems(rng, nw)
+    Fh = rng.normal(size=(nH, 6, nw)) + 1j * rng.normal(size=(nH, 6, nw))
+    outs = {}
+    for mode in ("auto", "jnp", "pallas"):
+        monkeypatch.setenv("RAFT_TPU_SMALLSOLVE", mode)
+        outs[mode] = np.asarray(smallsolve.solve_impedance_multi(
+            jnp.asarray(Z), jnp.asarray(Fh)))
+    np.testing.assert_array_equal(outs["auto"], outs["jnp"])
+    # identical arithmetic, different execution engine: tight tolerance
+    np.testing.assert_allclose(outs["pallas"], outs["jnp"],
+                               rtol=1e-12, atol=1e-12)
+
+
+def test_mode_validation(monkeypatch):
+    from raft_tpu.config import smallsolve_mode
+
+    monkeypatch.setenv("RAFT_TPU_SMALLSOLVE", "PALLAS")  # case-folded
+    assert smallsolve_mode() == "pallas"
+    monkeypatch.setenv("RAFT_TPU_SMALLSOLVE", "maybe")
+    with pytest.raises(ValueError, match="RAFT_TPU_SMALLSOLVE"):
+        smallsolve_mode()
+
+
+def test_auto_mode_off_tpu_is_jnp_without_benchmark(tune_cache, monkeypatch):
+    """'auto' off-TPU short-circuits to jnp: no benchmark runs (the CPU
+    test suite must not pay candidate compiles under the sentinel)."""
+    monkeypatch.setenv("RAFT_TPU_SMALLSOLVE", "auto")
+    kind, block, interpret = smallsolve._solver_choice(6, 1, 200)
+    assert (kind, block, interpret) == ("jnp", None, False)
+    assert tune_cache == {}  # nothing benchmarked, nothing cached
+    assert smallsolve.use_pallas(6, 1, 200) is False
+    assert smallsolve.use_pallas() is False  # legacy no-arg semantics
+
+
+def test_autotune_caches_pallas_winner(tune_cache):
+    """Fake benchmark where a Pallas block wins: the winner (path AND
+    block) is cached and served without re-benchmarking."""
+    calls = []
+
+    def bench(kind, block):
+        calls.append((kind, block))
+        if kind == "jnp":
+            return 10.0
+        return {256: 5.0, 512: 2.0}[block]  # 512 is fastest
+
+    entry = smallsolve.autotune(6, 1, 700, backend="faketpu", bench=bench,
+                                candidates=[256, 512])
+    assert entry["choice"] == "pallas" and entry["block"] == 512
+    assert entry["times"]["jnp"] == 10.0
+    # cache hit: same key never benchmarks again
+    n_calls = len(calls)
+    again = smallsolve.autotune(6, 1, 700, backend="faketpu", bench=bench)
+    assert again is entry and len(calls) == n_calls
+    rep = smallsolve.tuning_report()
+    assert rep["n6_m1_B700_faketpu"]["choice"] == "pallas"
+
+
+def test_autotune_caches_jnp_winner_and_failures(tune_cache):
+    """The BENCH_r05 regression case: when jnp times faster the tuner
+    must select it (caching 'jnp wins' is the whole point), and a
+    candidate that fails to compile is recorded, not fatal."""
+    def bench(kind, block):
+        if kind == "jnp":
+            return 1.0
+        if block == 256:
+            raise RuntimeError("mosaic VMEM overflow")
+        return 2.0  # pallas slower
+
+    entry = smallsolve.autotune(6, 1, 700, backend="faketpu", bench=bench,
+                                candidates=[256, 512])
+    assert entry["choice"] == "jnp" and entry["block"] is None
+    assert "mosaic VMEM overflow" in entry["errors"]["pallas_b256"]
+    assert "pallas_b256" not in entry["times"]
+
+
+def test_forced_pallas_uses_cached_block(tune_cache, monkeypatch):
+    """mode=pallas consults the tune cache for the block but never
+    benchmarks; off-TPU it runs in interpret mode."""
+    import jax
+
+    backend = jax.default_backend()
+    tune_cache[(6, 1, 64, backend)] = {"choice": "pallas", "block": 256,
+                                       "times": {}, "errors": {}}
+    monkeypatch.setenv("RAFT_TPU_SMALLSOLVE", "pallas")
+    kind, block, interpret = smallsolve._solver_choice(6, 1, 64)
+    assert kind == "pallas" and block == 256
+    assert interpret == (backend != "tpu")
+    assert smallsolve.use_pallas(6, 1, 64) is True
+    assert smallsolve.use_pallas() is True
+
+
+@pytest.mark.slow
+def test_autotune_real_timing_records_entry(tune_cache):
+    """Real (unmocked) autotune on a small problem: runs both paths on
+    this backend, records times, and picks SOME winner."""
+    entry = smallsolve.autotune(4, 1, 130, candidates=[128])
+    assert entry["choice"] in ("jnp", "pallas")
+    assert entry["times"]["jnp"] > 0.0
+    assert set(entry["times"]) >= {"jnp"}
+    # the decision is what the dispatcher will serve for this size
+    import jax
+
+    key = (4, 1, 130, jax.default_backend())
+    assert tune_cache[key] is entry
