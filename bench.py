@@ -20,6 +20,7 @@ device runtime of the 1000x12 solve is <1 s on one chip).
 
 import json
 import os
+import tempfile
 import time
 
 import numpy as np
@@ -27,6 +28,13 @@ import numpy as np
 
 def main():
     import jax
+
+    # arm the run ledger so every benchmarked sweep leaves an auditable
+    # event log; honour a caller-provided RAFT_TPU_LEDGER destination
+    ledger_dir = os.environ.get("RAFT_TPU_LEDGER")
+    if not ledger_dir:
+        ledger_dir = tempfile.mkdtemp(prefix="raft-bench-ledger-")
+        os.environ["RAFT_TPU_LEDGER"] = ledger_dir
 
     # Make both the accelerator and the CPU backend available.
     try:
@@ -130,6 +138,27 @@ def main():
             except Exception:
                 solver_ms[sname] = None
 
+    # run-ledger audit: both sweeps above wrote JSONL ledgers; validate
+    # the newest (the warm repeat) against the schema and surface the
+    # paths so a failed bench ships its own flight recording
+    from raft_tpu.obs import ledger as obs_ledger
+    from raft_tpu.obs import schema as obs_schema
+
+    runs = obs_ledger.list_runs(ledger_dir)
+    ledger_detail = {"dir": ledger_dir, "runs": len(runs)}
+    if runs:
+        events = obs_ledger.read_events(runs[-1])
+        counts: dict = {}
+        for ev in events:
+            name = ev.get("event", "?")
+            counts[name] = counts.get(name, 0) + 1
+        ledger_detail.update({
+            "newest": runs[-1],
+            "events": len(events),
+            "schema_errors": obs_schema.validate_events(events),
+            "event_counts": counts,
+        })
+
     result = {
         "metric": (f"{n_designs}-design x {n_case}-sea-state END-TO-END sweep wall-clock "
                    f"({name}, 200 w-bins, strip theory + aero-servo impedance, "
@@ -161,6 +190,9 @@ def main():
             # (RAFT_TPU_SMALLSOLVE mode + per-size winner incl. block)
             "smallsolve_mode": smallsolve_mode(),
             "smallsolve_tuning": ss.tuning_report(),
+            # run-ledger audit of the benchmarked sweeps (schema_errors
+            # must be []); render with `python -m raft_tpu.obs.report`
+            "ledger": ledger_detail,
         },
     }
     print(json.dumps(result))

@@ -42,6 +42,10 @@ GL-F64-LITERAL  dtype-widening literal (``float64``/``complex128``) inside
 GL-NESTED-JIT   ``jax.jit``/``pjit``/``pmap`` called inside a traced
                 function (a fresh wrapper per outer trace defeats the jit
                 cache).
+GL-PRINT        bare ``print(`` in library code: output bypasses the
+                run-id-stamped loggers and the run ledger
+                (:mod:`raft_tpu.obs.log`).  CLI/report modules are
+                exempted via ``[lint] print_exempt`` in graftlint.toml.
 ==============  ============================================================
 
 Suppression: trailing ``# graftlint: disable=GL-XXX[,GL-YYY]`` on the
@@ -72,6 +76,7 @@ ALL_RULES = (
     "GL-STATIC-ARGS",
     "GL-F64-LITERAL",
     "GL-NESTED-JIT",
+    "GL-PRINT",
 )
 
 # call sites whose function-valued arguments run under a trace.  Maps the
@@ -140,6 +145,8 @@ class Violation:
 class Config:
     kernel_dirs: tuple = ("ops", "hydro", "parallel")
     extra_trace_roots: tuple = ()
+    # relpath suffixes of CLI/report modules where print IS the product
+    print_exempt: tuple = ()
     baseline: dict = field(default_factory=dict)
     sentinel: dict = field(default_factory=dict)
 
@@ -156,6 +163,7 @@ def load_config(path):
     lint = data.get("lint", {})
     cfg.kernel_dirs = tuple(lint.get("kernel_dirs", cfg.kernel_dirs))
     cfg.extra_trace_roots = tuple(lint.get("extra_trace_roots", ()))
+    cfg.print_exempt = tuple(lint.get("print_exempt", ()))
     cfg.baseline = dict(data.get("baseline", {}))
     cfg.sentinel = dict(data.get("sentinel", {}))
     return cfg
@@ -742,6 +750,20 @@ class _FileLinter:
                 self.report(e, "GL-STATIC-ARGS",
                             f"unhashable {kwname} element")
 
+    def _check_print(self):
+        rel = self.relpath.replace(os.sep, "/")
+        if any(rel.endswith(suffix) for suffix in self.cfg.print_exempt):
+            return  # CLI/report module: print IS the product
+        for node in ast.walk(self.tree):
+            if (isinstance(node, ast.Call)
+                    and isinstance(node.func, ast.Name)
+                    and node.func.id == "print"):
+                self.report(node, "GL-PRINT",
+                            "bare print() in library code bypasses the "
+                            "run-id-stamped loggers and the run ledger; "
+                            "route through raft_tpu.obs.log "
+                            "(display()/warn()/get_logger())")
+
     def _in_kernel_dir(self):
         parts = self.relpath.replace(os.sep, "/").split("/")
         return any(d in parts for d in self.cfg.kernel_dirs)
@@ -810,6 +832,7 @@ class _FileLinter:
 
         self._check_bare_except()
         self._check_static_args()
+        self._check_print()
         self._check_f64_literals(traced)
         return self.violations
 
@@ -876,6 +899,7 @@ def write_config(path, cfg, baseline_counts):
              f"kernel_dirs = {list(cfg.kernel_dirs)!r}".replace("'", '"'),
              f"extra_trace_roots = {list(cfg.extra_trace_roots)!r}".replace(
                  "'", '"'),
+             f"print_exempt = {list(cfg.print_exempt)!r}".replace("'", '"'),
              ""]
     if cfg.sentinel:
         lines.append("[sentinel]")

@@ -60,6 +60,28 @@ class _LogCounter(logging.Handler):
             self.sentinel.traces_by_name[m.group(1)] += 1
 
 
+class _SquelchFilter(logging.Filter):
+    """Suppress the DEBUG records the sentinel's level change unlocked.
+
+    Lowering the jax compile loggers to DEBUG makes every per-compile
+    line reach jax's own stderr handler — spew the user never asked for.
+    This filter, attached to the PRE-EXISTING handlers while a sentinel
+    is active, drops exactly the records that would not have been
+    emitted under the logger's original effective level; logging the
+    user explicitly enabled (e.g. ``jax_log_compiles``) passes through
+    unchanged.  The sentinel's own counter handler carries no such
+    filter, so counting is unaffected.
+    """
+
+    def __init__(self, original_levels):
+        super().__init__()
+        self.original_levels = original_levels
+
+    def filter(self, record):
+        orig = self.original_levels.get(record.name)
+        return orig is None or record.levelno >= orig
+
+
 class RecompileSentinel:
     """Context manager counting XLA compiles while active.
 
@@ -84,6 +106,7 @@ class RecompileSentinel:
         self.traces_by_name: Counter = Counter()
         self._handler = None
         self._old_levels = {}
+        self._squelched = []
 
     # -- monitoring plumbing (class-level fanout to active sentinels) --
 
@@ -107,14 +130,23 @@ class RecompileSentinel:
         self._ensure_registered()
         RecompileSentinel._active.append(self)
         self._handler = _LogCounter(self)
+        effective = {}
         for name in _COMPILE_LOGGERS:
             logger = logging.getLogger(name)
             self._old_levels[name] = logger.level
+            effective[name] = logger.getEffectiveLevel()
             # per-compile lines log at DEBUG unless jax_log_compiles; the
             # handler needs the logger to pass DEBUG records through
             if logger.level == 0 or logger.level > logging.DEBUG:
                 logger.setLevel(logging.DEBUG)
             logger.addHandler(self._handler)
+        # keep the unlocked DEBUG records out of pre-existing handlers
+        # (jax attaches a stderr handler to the "jax" logger)
+        squelch = _SquelchFilter(effective)
+        for anc in ("jax", ""):
+            for h in logging.getLogger(anc).handlers:
+                h.addFilter(squelch)
+                self._squelched.append((h, squelch))
         return self
 
     def __exit__(self, *exc):
@@ -123,6 +155,9 @@ class RecompileSentinel:
             logger = logging.getLogger(name)
             logger.removeHandler(self._handler)
             logger.setLevel(self._old_levels.get(name, 0))
+        for h, squelch in self._squelched:
+            h.removeFilter(squelch)
+        self._squelched = []
         return False
 
     # -- assertions --

@@ -97,6 +97,50 @@ def executor_config(overrides=None) -> dict:
     return cfg
 
 
+# ---------------------------------------------------------------------------
+# run-ledger telemetry / trace capture (raft_tpu.obs)
+# ---------------------------------------------------------------------------
+
+# Defaults for the observability layer (see docs/observability.md).
+# `ledger_dir` turns the structured run ledger ON: every sweep() run
+# appends typed JSON-lines events to a per-run file under that
+# directory (None = off, the default — the telemetry-off path adds no
+# work beyond a no-op method call per lifecycle point and never touches
+# a traced program).  `trace_dir` arms on-demand `jax.profiler.trace`
+# capture around the phases named in `trace_phases` (empty tuple =
+# every armed phase).  Environment overrides: RAFT_TPU_LEDGER=dir,
+# RAFT_TPU_TRACE=dir, RAFT_TPU_TRACE_PHASES=chunks,compile.
+OBS_DEFAULTS = {
+    "ledger_dir": None,
+    "trace_dir": None,
+    "trace_phases": ("chunks",),
+}
+
+
+def obs_config(overrides=None) -> dict:
+    """Effective observability configuration: defaults, then
+    environment, then explicit ``overrides``."""
+    import os
+
+    cfg = dict(OBS_DEFAULTS)
+    env = os.environ.get("RAFT_TPU_LEDGER")
+    if env is not None:
+        cfg["ledger_dir"] = env or None
+    env = os.environ.get("RAFT_TPU_TRACE")
+    if env is not None:
+        cfg["trace_dir"] = env or None
+    env = os.environ.get("RAFT_TPU_TRACE_PHASES")
+    if env is not None:
+        cfg["trace_phases"] = tuple(
+            p.strip() for p in env.split(",") if p.strip())
+    if overrides:
+        unknown = set(overrides) - set(cfg)
+        if unknown:
+            raise ValueError(f"unknown obs config key(s): {sorted(unknown)}")
+        cfg.update(overrides)
+    return cfg
+
+
 # Solver-path selection for the batched 6x6 impedance solves
 # (raft_tpu.parallel.smallsolve): 'auto' benchmarks the Pallas kernel
 # against the plain-jnp elimination at first use per (n, m, B, backend)

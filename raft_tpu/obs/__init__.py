@@ -1,0 +1,40 @@
+"""Observability for production-scale sweeps: run ledger, structured
+logging, and on-demand trace capture.
+
+The reference RAFT's only instrumentation is one ad-hoc QTF timer
+(raft_model.py:980-984).  Debugging a pipelined thousand-design sweep —
+did the executables compile or deserialize, how deep did the pipeline
+actually run, which chunk faulted and what was bisected out, how many
+bytes moved, did the checkpoint writer keep up — needs a durable record,
+not scattered prints.  This package provides it in four layers:
+
+* :mod:`raft_tpu.obs.ledger` — per-run JSON-lines event files
+  (``RAFT_TPU_LEDGER=dir``; off by default, zero overhead off), run
+  ids + design-batch fingerprints, typed events per
+  :mod:`raft_tpu.obs.schema`.
+* :mod:`raft_tpu.obs.log` — ``raft_tpu.*``-namespaced loggers whose
+  records carry the active run id; the ``warn``/``display`` funnels
+  library code routes its output through (GL-PRINT bans bare prints).
+* :mod:`raft_tpu.obs.trace` — ``jax.profiler.trace`` capture hooks
+  (``RAFT_TPU_TRACE=dir``) around chosen sweep phases.
+* :mod:`raft_tpu.obs.report` — ``python -m raft_tpu.obs.report <dir>``:
+  phase waterfall, compile-vs-execute split, bytes moved, quarantine
+  timeline, ETA accuracy.
+
+See docs/observability.md.
+"""
+
+from .ledger import (  # noqa: F401
+    NULL_RUN,
+    Run,
+    current_run,
+    emit,
+    emit_device_memory,
+    enabled,
+    list_runs,
+    read_events,
+    start_run,
+    tree_nbytes,
+)
+from .log import display, get_logger, warn  # noqa: F401
+from .trace import maybe_trace  # noqa: F401

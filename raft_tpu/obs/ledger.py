@@ -1,0 +1,293 @@
+"""Run ledger: durable, structured telemetry for sweep-scale runs.
+
+Every ``sweep()`` invocation becomes a *run*: a unique run id, a
+config/design-batch fingerprint, and an append-only JSON-lines file of
+typed, timestamped events (:mod:`raft_tpu.obs.schema`) under
+``RAFT_TPU_LEDGER=dir``.  The pieces of "what happened in this sweep"
+that used to live in four uncorrelated fragments — phase timers
+(:mod:`raft_tpu.profiling`), the RecompileSentinel, the robust/ health
+report, and bench ``detail`` blobs — land in one file, keyed by one id,
+renderable by ``python -m raft_tpu.obs.report``.
+
+Off by default.  When ``RAFT_TPU_LEDGER`` is unset, :func:`start_run`
+returns the :data:`NULL_RUN` singleton whose ``emit``/``close`` are
+no-ops and whose ``enabled`` flag gates every byte-counting or
+stat-gathering expression at the call sites — the telemetry-off sweep
+path does no extra host work and (by construction: nothing here touches
+jit/lowering) compiles no extra XLA programs.
+
+Thread-safety: one run's events may be emitted from the sweep's main
+thread, the AOT compile workers, and the background checkpoint-writer
+thread; ``emit`` serializes on a per-run lock and stamps a per-run
+``seq`` so the file carries a total order even under interleaving.
+
+While a run is active it registers a :mod:`raft_tpu.profiling` listener,
+so every completed phase streams into the ledger as a ``phase`` event
+(the waterfall's raw material) and is aggregated into per-phase
+``phase_stats`` (count/total/min/mean/max) emitted at close.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from .. import profiling
+from ..config import obs_config
+
+__all__ = [
+    "Run", "NULL_RUN", "start_run", "current_run", "emit", "enabled",
+    "emit_device_memory", "tree_nbytes", "list_runs", "read_events",
+]
+
+
+def enabled() -> bool:
+    """True when the ledger is armed (``RAFT_TPU_LEDGER`` set)."""
+    return obs_config()["ledger_dir"] is not None
+
+
+def _jsonable(obj):
+    """json.dumps fallback for numpy scalars/arrays and anything else."""
+    if isinstance(obj, np.integer):
+        return int(obj)
+    if isinstance(obj, np.floating):
+        return float(obj)
+    if isinstance(obj, np.bool_):
+        return bool(obj)
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    return repr(obj)
+
+
+class NullRun:
+    """Telemetry-off stand-in: every operation is a cheap no-op."""
+
+    enabled = False
+    run_id = None
+    path = None
+
+    def emit(self, event, **fields):
+        pass
+
+    def elapsed(self) -> float:
+        return 0.0
+
+    def finish(self, ok, **fields):
+        pass
+
+    def close(self):
+        pass
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        return False
+
+
+NULL_RUN = NullRun()
+
+# stack of active runs (module-global: the sweep is single-run at a
+# time; nested runs would stack, and threads emit through the Run
+# object they captured, not through this stack)
+_ACTIVE: list = []
+
+
+def current_run():
+    """The innermost active run, or :data:`NULL_RUN`."""
+    return _ACTIVE[-1] if _ACTIVE else NULL_RUN
+
+
+def emit(event, **fields):
+    """Emit on the current run (no-op when no ledger is active).
+
+    The module-level entry point for code that is *called from* a run
+    (quarantine bisection, health reporting) rather than owning one.
+    """
+    current_run().emit(event, **fields)
+
+
+class Run:
+    """One ledger run: an open JSONL file plus the emission state."""
+
+    enabled = True
+
+    def __init__(self, kind, ledger_dir, fingerprint=None, meta=None):
+        os.makedirs(ledger_dir, exist_ok=True)
+        stamp = time.strftime("%Y%m%dT%H%M%S")
+        self.run_id = f"{stamp}-{kind}-{os.getpid()}-{time.time_ns() % 10**6:06d}"
+        self.kind = kind
+        self.path = os.path.join(ledger_dir, f"{self.run_id}.jsonl")
+        self._t0 = time.time()
+        self._seq = 0
+        self._lock = threading.Lock()
+        self._closed = False
+        self._phase_agg: dict = {}
+        self._fh = open(self.path, "a", encoding="utf-8")
+        _ACTIVE.append(self)
+        self._listener = self._on_phase
+        profiling.add_listener(self._listener)
+        self.emit("run_start", run_id=self.run_id, kind=kind,
+                  fingerprint=fingerprint, meta=meta)
+
+    # -- emission ---------------------------------------------------------
+
+    def emit(self, event, **fields):
+        """Append one typed event (thread-safe; drops after close)."""
+        with self._lock:
+            if self._closed:
+                return
+            self._seq += 1
+            rec = {"t": round(time.time(), 6), "seq": self._seq,
+                   "event": event}
+            rec.update(fields)
+            self._fh.write(json.dumps(rec, default=_jsonable) + "\n")
+            self._fh.flush()
+
+    def elapsed(self) -> float:
+        return time.time() - self._t0
+
+    # -- profiling bridge -------------------------------------------------
+
+    def _on_phase(self, name, seconds):
+        # called from whichever thread exits the phase; aggregate under
+        # the emit lock's protection is overkill, so use a tiny critical
+        # section of our own via dict operations guarded by _lock inside
+        # emit; the aggregate update itself needs the lock too
+        with self._lock:
+            if self._closed:
+                return
+            agg = self._phase_agg.get(name)
+            if agg is None:
+                self._phase_agg[name] = [1, seconds, seconds, seconds]
+            else:
+                agg[0] += 1
+                agg[1] += seconds
+                agg[2] = min(agg[2], seconds)
+                agg[3] = max(agg[3], seconds)
+        self.emit("phase", name=name, seconds=round(seconds, 6))
+
+    # -- shutdown ---------------------------------------------------------
+
+    def _flush_phase_stats(self):
+        """Emit aggregated per-phase stats (once)."""
+        # stop listening first so the stats snapshot is final
+        profiling.remove_listener(self._listener)
+        with self._lock:
+            agg, self._phase_agg = dict(self._phase_agg), {}
+        for name in sorted(agg):
+            calls, total, mn, mx = agg[name]
+            self.emit("phase_stats", name=name, calls=calls,
+                      total=round(total, 6), min=round(mn, 6),
+                      mean=round(total / calls, 6), max=round(mx, 6))
+
+    def finish(self, ok, **fields):
+        """Orderly run termination: phase stats, then the ``run_end``
+        event (the stream's schema-mandated last record), then close."""
+        if self._closed:
+            return
+        self._flush_phase_stats()
+        self.emit("run_end", ok=ok, **fields)
+        self.close()
+
+    def close(self):
+        """Detach from profiling and close the file.  A close without
+        :meth:`finish` (crash backstop) still flushes phase stats, but
+        the stream then ends without ``run_end`` — exactly the signature
+        the report CLI renders as "run still open or killed"."""
+        if self._closed:
+            return
+        self._flush_phase_stats()
+        with self._lock:
+            self._closed = True
+            self._fh.close()
+        if self in _ACTIVE:
+            _ACTIVE.remove(self)
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+
+def start_run(kind, fingerprint=None, meta=None):
+    """Open a ledger run, or return :data:`NULL_RUN` when disabled.
+
+    The env knob is re-read per call (not latched at import), so tests
+    and drivers can arm/disarm the ledger around individual sweeps.
+    """
+    ledger_dir = obs_config()["ledger_dir"]
+    if ledger_dir is None:
+        return NULL_RUN
+    return Run(kind, ledger_dir, fingerprint=fingerprint, meta=meta)
+
+
+def emit_device_memory(run, device=None, what=""):
+    """Best-effort live device-memory watermark event.
+
+    ``memory_stats()`` is a per-backend optional API (TPU reports
+    ``bytes_in_use``/``peak_bytes_in_use``; CPU returns None) — absence
+    is recorded as nulls, never an error.
+    """
+    if not run.enabled:
+        return
+    bytes_in_use = peak = err = None
+    name = str(device) if device is not None else None
+    try:
+        import jax
+
+        d = device if device is not None else jax.devices()[0]
+        name = str(d)
+        stats = d.memory_stats()
+        if stats:
+            bytes_in_use = int(stats.get("bytes_in_use", 0)) or None
+            peak = int(stats.get("peak_bytes_in_use", 0)) or None
+    except Exception as e:  # noqa: BLE001 - telemetry must never kill the run
+        err = f"{type(e).__name__}: {e}"
+    run.emit("device_memory", device=name, bytes_in_use=bytes_in_use,
+             peak_bytes=peak, what=what, error=err)
+
+
+def tree_nbytes(tree) -> int:
+    """Total byte size of the array leaves of a pytree (host or device
+    arrays; non-array leaves contribute 0).  Used for transfer
+    accounting at the put/fetch boundaries."""
+    import jax
+
+    return int(sum(getattr(leaf, "nbytes", 0)
+                   for leaf in jax.tree_util.tree_leaves(tree)))
+
+
+def list_runs(ledger_dir):
+    """Ledger files under ``ledger_dir``, oldest first."""
+    if not os.path.isdir(ledger_dir):
+        return []
+    paths = [os.path.join(ledger_dir, f) for f in os.listdir(ledger_dir)
+             if f.endswith(".jsonl")]
+    return sorted(paths)
+
+
+def read_events(path):
+    """Decode one ledger file into a list of event dicts.
+
+    Truncated trailing lines (a run killed mid-write) are dropped
+    rather than raised on — the ledger exists to debug exactly such
+    runs.
+    """
+    events = []
+    with open(path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                events.append(json.loads(line))
+            except ValueError:
+                break
+    return events

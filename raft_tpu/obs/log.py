@@ -1,0 +1,73 @@
+"""Run-id-stamping loggers: the single output funnel for library code.
+
+Library modules used to mix ``warnings.warn`` with bare ``print``
+under ``display`` flags, so a production sweep's narrative was split
+between stderr, stdout, and nothing at all.  This module gives every
+raft_tpu module one ``logging`` logger namespaced under ``raft_tpu.*``
+whose records carry the ACTIVE RUN ID (``record.run_id``, "-" outside a
+run) so log aggregation correlates lines with ledger files, plus two
+helpers that preserve the established user-facing contracts:
+
+* :func:`warn` — logs at WARNING, mirrors into the ledger as a
+  ``warning`` event, and still raises the ``warnings.warn`` category
+  callers and tests rely on (``pytest.warns(RuntimeWarning, ...)``
+  keeps working).
+* :func:`display` — logs at INFO and prints to stdout; the ONLY
+  sanctioned ``print`` in library code (the GL-PRINT graftlint rule
+  bans the rest), kept because ``display=1`` is the reference-style
+  interactive progress contract and must not require logging config.
+"""
+
+from __future__ import annotations
+
+import logging
+import warnings
+
+from . import ledger
+
+__all__ = ["get_logger", "warn", "display"]
+
+_PACKAGE = "raft_tpu"
+
+
+class _RunIdFilter(logging.Filter):
+    """Stamp ``record.run_id`` with the active ledger run id (or '-')."""
+
+    def filter(self, record):
+        if not hasattr(record, "run_id"):
+            record.run_id = ledger.current_run().run_id or "-"
+        return True
+
+
+_RUN_ID_FILTER = _RunIdFilter()
+
+
+def get_logger(name: str) -> logging.Logger:
+    """``logging.getLogger('raft_tpu.<name>')`` with the run-id filter.
+
+    Filters do not propagate down the logger hierarchy, so the filter is
+    attached to each leaf logger this function hands out (idempotent).
+    """
+    logger = logging.getLogger(f"{_PACKAGE}.{name}")
+    if _RUN_ID_FILTER not in logger.filters:
+        logger.addFilter(_RUN_ID_FILTER)
+    return logger
+
+
+def warn(logger: logging.Logger, message: str,
+         category=RuntimeWarning, stacklevel: int = 2) -> None:
+    """Surface a library warning on every channel at once: the
+    raft_tpu logger (run-id-stamped), the run ledger, and the Python
+    warnings machinery (the API contract existing callers/tests catch).
+    """
+    logger.warning(message)
+    ledger.emit("warning", message=str(message))
+    warnings.warn(message, category, stacklevel=stacklevel + 1)
+
+
+def display(logger: logging.Logger, message: str) -> None:
+    """Interactive progress line: stdout for the ``display=1`` user,
+    INFO for log aggregation.  Call sites keep their ``if display:``
+    guards — this helper is the output funnel, not the policy."""
+    logger.info(message)
+    print(message)  # graftlint: disable=GL-PRINT

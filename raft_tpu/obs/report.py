@@ -1,0 +1,272 @@
+"""Render a run-ledger file as a human-readable run summary.
+
+CLI::
+
+    python -m raft_tpu.obs.report <ledger.jsonl | ledger-dir> [--validate]
+
+Given a directory, the newest run file is rendered (``--all`` lists
+every run first).  Sections: run header, phase waterfall (when each
+phase first ran and where the time went), compile-vs-execute split
+(cache hits vs real XLA compiles, costed), data movement (bytes by
+direction), chunk pipeline timeline with ETA accuracy, quarantine /
+health timeline, and checkpoint-writer activity.
+
+This is a CLI module: it prints (exempted from the GL-PRINT lint rule
+via ``print_exempt`` in graftlint.toml).
+"""
+
+from __future__ import annotations
+
+import sys
+
+from . import ledger as _ledger
+from . import schema as _schema
+
+_BAR_WIDTH = 36
+
+
+def _fmt_bytes(n):
+    if n is None:
+        return "?"
+    for unit in ("B", "KiB", "MiB", "GiB"):
+        if abs(n) < 1024.0 or unit == "GiB":
+            return f"{n:.1f} {unit}" if unit != "B" else f"{int(n)} B"
+        n /= 1024.0
+
+
+def _bar(frac, width=_BAR_WIDTH):
+    n = max(0, min(width, int(round(frac * width))))
+    return "#" * n + "." * (width - n)
+
+
+def _by_event(events):
+    out: dict = {}
+    for ev in events:
+        out.setdefault(ev.get("event"), []).append(ev)
+    return out
+
+
+def _section(title):
+    return [f"", f"== {title} " + "=" * max(0, 60 - len(title))]
+
+
+def render(events):
+    """Render one run's event list to a list of text lines."""
+    lines = []
+    if not events:
+        return ["(empty ledger)"]
+    by = _by_event(events)
+    t0 = events[0].get("t", 0.0)
+    t_end = events[-1].get("t", t0)
+    span = max(t_end - t0, 1e-9)
+
+    # ---- header ---------------------------------------------------------
+    start = (by.get("run_start") or [{}])[0]
+    end = (by.get("run_end") or [{}])[-1]
+    lines.append(f"run      {start.get('run_id', '?')}  ({start.get('kind', '?')})")
+    meta = start.get("meta") or {}
+    if meta:
+        lines.append("meta     " + ", ".join(f"{k}={v}" for k, v in meta.items()))
+    fp = start.get("fingerprint")
+    if fp:
+        if isinstance(fp, dict):
+            fp = ", ".join(f"{k}={v}" for k, v in fp.items())
+        lines.append(f"batch    {fp}")
+    plan = (by.get("plan") or [{}])[0]
+    if plan.get("mode") is not None:
+        lines.append(
+            f"plan     mode={plan.get('mode')} chunks={plan.get('n_chunks')}"
+            f"x{plan.get('chunk_size')} pipeline_depth="
+            f"{plan.get('pipeline_depth')} resident={plan.get('resident')}")
+    ok = end.get("ok")
+    status = "ok" if ok else ("FAILED: " + str(end.get("error")) if ok is False
+                              else "(no run_end — run still open or killed)")
+    lines.append(f"span     {span:.3f} s   events {len(events)}   end {status}")
+    counts = end.get("counts")
+    if counts:
+        lines.append("designs  " + ", ".join(f"{v} {k}" for k, v in counts.items() if v))
+
+    # ---- phase waterfall ------------------------------------------------
+    stats = {ev["name"]: ev for ev in by.get("phase_stats", [])}
+    first_t: dict = {}
+    for ev in by.get("phase", []):
+        name = ev.get("name")
+        if name not in first_t:
+            # the phase event fires at phase EXIT; start = t - seconds
+            first_t[name] = ev.get("t", t0) - ev.get("seconds", 0.0)
+    if stats or first_t:
+        lines += _section("phase waterfall")
+        names = sorted(set(stats) | set(first_t),
+                       key=lambda n: first_t.get(n, t_end))
+        width = max((len(n) for n in names), default=5)
+        lines.append(f"{'phase':<{width}}  {'start':>8}  {'total_s':>8}  "
+                     f"{'calls':>5}  {'mean_s':>8}  {'max_s':>8}")
+        for name in names:
+            st = stats.get(name, {})
+            total = st.get("total", 0.0)
+            off = max(first_t.get(name, t0) - t0, 0.0)
+            lines.append(
+                f"{name:<{width}}  {off:>7.3f}s  {total:>8.3f}  "
+                f"{st.get('calls', 0):>5}  {st.get('mean', 0.0):>8.4f}  "
+                f"{st.get('max', 0.0):>8.4f}  |{_bar(total / span)}|")
+
+    # ---- compile vs execute ---------------------------------------------
+    compiles = by.get("compile_end", [])
+    cache_hits = by.get("compile_cache", [])
+    exec_s = sum(st.get("total", 0.0) for name, st in stats.items()
+                 if name.endswith("chunks/compute"))
+    if compiles or cache_hits or exec_s:
+        lines += _section("compile vs execute")
+        compile_s = 0.0
+        for ev in compiles:
+            secs = ev.get("seconds") or 0.0
+            compile_s += secs
+            lines.append(
+                f"executable {ev.get('key')}: {secs:.3f} s "
+                f"({ev.get('cache')}, {ev.get('xla_compiles', '?')} XLA "
+                "backend compile(s))")
+        for ev in cache_hits:
+            lines.append("executables: reused from in-process template memo "
+                         "(cache hit, 0 compiles)")
+        lines.append(f"compile {compile_s:.3f} s vs chunk execute "
+                     f"{exec_s:.3f} s"
+                     + (f"  ({compile_s / (compile_s + exec_s) * 100.0:.0f}% "
+                        "of compile+execute spent compiling)"
+                        if compile_s + exec_s > 0 else ""))
+
+    # ---- data movement --------------------------------------------------
+    transfers = by.get("transfer", [])
+    fetches = by.get("chunk_fetch", [])
+    if transfers or fetches:
+        lines += _section("data movement")
+        h2d = sum(ev.get("bytes", 0) for ev in transfers
+                  if ev.get("direction") == "h2d")
+        d2h = (sum(ev.get("bytes", 0) for ev in transfers
+                   if ev.get("direction") == "d2h")
+               + sum(ev.get("bytes", 0) for ev in fetches))
+        lines.append(f"host->device {_fmt_bytes(h2d)} in {len(transfers)} "
+                     f"transfer event(s); device->host {_fmt_bytes(d2h)} "
+                     f"across {len(fetches)} chunk fetch(es)")
+        for ev in transfers[:8]:
+            lines.append(f"  h2d {ev.get('what')}: {_fmt_bytes(ev.get('bytes'))}")
+        for ev in by.get("device_memory", []):
+            lines.append(
+                f"  device memory [{ev.get('what') or '-'}] {ev.get('device')}: "
+                f"in_use={_fmt_bytes(ev.get('bytes_in_use'))} "
+                f"peak={_fmt_bytes(ev.get('peak_bytes'))}")
+
+    # ---- chunk pipeline / ETA accuracy ----------------------------------
+    commits = by.get("chunk_commit", [])
+    dispatches = by.get("chunk_dispatch", [])
+    if commits or dispatches:
+        lines += _section("chunk pipeline")
+        max_depth = max((ev.get("in_flight", 1) for ev in dispatches),
+                        default=0)
+        lines.append(f"{len(dispatches)} chunk(s) dispatched, "
+                     f"{len(commits)} committed, peak in-flight {max_depth}")
+        eta_errs = []
+        for ev in commits:
+            actual_remaining = t_end - ev.get("t", t_end)
+            eta = ev.get("eta_s")
+            if eta is not None and ev.get("done", 0) < ev.get("n_designs", 0):
+                eta_errs.append(abs(eta - actual_remaining))
+            lines.append(
+                f"  chunk {ev.get('chunk')}: {ev.get('done')}/"
+                f"{ev.get('n_designs')} designs at t+{ev.get('t', t0) - t0:.3f}s"
+                + (f", eta {eta:.3f}s (actual {actual_remaining:.3f}s)"
+                   if eta is not None else ""))
+        if eta_errs:
+            lines.append(f"ETA accuracy: mean abs error "
+                         f"{sum(eta_errs) / len(eta_errs):.3f} s over "
+                         f"{len(eta_errs)} mid-run estimate(s)")
+
+    # ---- quarantine / health timeline -----------------------------------
+    fault_events = []
+    for name in ("chunk_fault", "quarantine_retry", "quarantine_bisect",
+                 "design_quarantined", "status_transition", "warning"):
+        fault_events += by.get(name, [])
+    fault_events.sort(key=lambda ev: ev.get("seq", 0))
+    health = (by.get("health_report") or [{}])[-1]
+    if fault_events or health.get("counts"):
+        lines += _section("quarantine / health timeline")
+        for ev in fault_events:
+            what = {
+                "chunk_fault": lambda e: f"chunk {e.get('start')}-{e.get('stop')} "
+                                         f"raised: {e.get('error')}",
+                "quarantine_retry": lambda e: f"retrying {e.get('n')} design(s)",
+                "quarantine_bisect": lambda e: f"bisecting {e.get('n')} design(s)",
+                "design_quarantined": lambda e: f"quarantined designs "
+                                                f"{e.get('designs')}",
+                "status_transition": lambda e: f"designs {e.get('designs')} "
+                                               f"-> {e.get('to')}",
+                "warning": lambda e: f"warning: {e.get('message')}",
+            }[ev["event"]](ev)
+            lines.append(f"  t+{ev.get('t', t0) - t0:8.3f}s  {what}")
+        if health.get("counts"):
+            lines.append("final health: " + ", ".join(
+                f"{v} {k}" for k, v in health["counts"].items() if v))
+
+    # ---- checkpoint writer ----------------------------------------------
+    flushes = by.get("checkpoint_flush", [])
+    if flushes:
+        lines += _section("checkpoint writer")
+        n_fail = sum(1 for ev in flushes if not ev.get("ok"))
+        total = sum(ev.get("seconds", 0.0) for ev in flushes)
+        lines.append(f"{len(flushes)} flush(es), {n_fail} failed, "
+                     f"{total:.3f} s total write time (off the hot loop)")
+
+    traces = by.get("trace_capture", [])
+    for ev in traces:
+        lines.append(f"jax.profiler trace captured for phase "
+                     f"{ev.get('phase')!r} -> {ev.get('dir')}")
+    return lines
+
+
+def main(argv=None):
+    import argparse
+
+    ap = argparse.ArgumentParser(
+        prog="python -m raft_tpu.obs.report",
+        description="Render a raft_tpu run-ledger file as a run summary")
+    ap.add_argument("path", help="ledger .jsonl file or ledger directory "
+                                 "(newest run is rendered)")
+    ap.add_argument("--all", action="store_true",
+                    help="for a directory: render every run, oldest first")
+    ap.add_argument("--validate", action="store_true",
+                    help="also validate events against the schema; exit "
+                         "nonzero on schema errors")
+    args = ap.parse_args(argv)
+
+    import os
+
+    if os.path.isdir(args.path):
+        runs = _ledger.list_runs(args.path)
+        if not runs:
+            print(f"no ledger runs under {args.path}")
+            return 1
+        paths = runs if args.all else runs[-1:]
+    else:
+        paths = [args.path]
+
+    rc = 0
+    for i, path in enumerate(paths):
+        if i:
+            print("\n" + "=" * 72 + "\n")
+        events = _ledger.read_events(path)
+        print(f"ledger   {path}")
+        for line in render(events):
+            print(line)
+        if args.validate:
+            errors = _schema.validate_events(events)
+            if errors:
+                rc = 1
+                print(f"\nschema: {len(errors)} error(s)")
+                for e in errors[:20]:
+                    print(f"  {e}")
+            else:
+                print(f"\nschema: ok ({len(events)} events)")
+    return rc
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -1,0 +1,121 @@
+"""Run-ledger event schema: the typed vocabulary of sweep telemetry.
+
+Every ledger line is one JSON object with the base fields
+
+``t``      wall-clock timestamp (``time.time()``, seconds),
+``seq``    per-run monotonically increasing integer (total order of
+           emission, stable across the writer/compile threads),
+``event``  one of the names below,
+
+plus the event's required fields (and any extra keys — the schema is
+open: consumers must ignore fields they do not know, so events can grow
+fields without a version bump).  :func:`validate_events` is the single
+checker the bench, the tests, and the report CLI share.
+
+Lifecycle of one ``sweep()`` run (see docs/observability.md for the
+full narrative)::
+
+    run_start -> template_build -> stack_build -> plan
+              -> compile_start/compile_end (per executable) | compile_cache
+              -> transfer (resident upload) -> device_memory
+              -> { chunk_dispatch -> chunk_fetch -> chunk_commit }*
+                 with chunk_fault / quarantine_* / status_transition
+                 and checkpoint_flush interleaved
+              -> phase* (streamed) -> phase_stats* -> health_report
+              -> run_end
+"""
+
+from __future__ import annotations
+
+BASE_FIELDS = ("t", "seq", "event")
+
+# event name -> required fields (beyond the base fields).  Optional
+# fields are listed in docs/observability.md; validation only enforces
+# the required set plus basic types for the base fields.
+EVENTS: dict[str, tuple] = {
+    # -- run lifecycle ----------------------------------------------------
+    "run_start": ("run_id", "kind"),            # + fingerprint, meta
+    "run_end": ("ok",),                         # + counts | error
+    "plan": ("mode", "n_chunks", "chunk_size"),  # + pipeline_depth, resident
+    # -- build / compile --------------------------------------------------
+    "template_build": ("cache",),               # 'hit' | 'build'; + seconds
+    "stack_build": ("cache",),                  # 'hit' | 'build'; + seconds
+    "compile_start": ("key",),                  # executable key ('A' | 'B')
+    "compile_end": ("key", "cache"),            # + seconds, xla_compiles
+    "compile_cache": ("cache",),                # memoized executables reused
+    # -- data movement / device state ------------------------------------
+    "transfer": ("direction", "bytes", "what"),  # 'h2d' | 'd2h'
+    "device_memory": ("device",),               # + bytes_in_use, peak_bytes
+    # -- chunk loop -------------------------------------------------------
+    "chunk_dispatch": ("chunk", "start", "stop", "n_real", "in_flight"),
+    "chunk_fetch": ("chunk", "bytes"),
+    "chunk_commit": ("chunk", "done", "n_designs"),  # + eta_s
+    # -- faults / health --------------------------------------------------
+    "chunk_fault": ("start", "stop", "error"),
+    "quarantine_retry": ("n",),
+    "quarantine_bisect": ("n",),
+    "design_quarantined": ("designs",),         # + error
+    "status_transition": ("designs", "to"),
+    "health_report": ("counts",),               # + all_ok, quarantined
+    # -- persistence / phases / traces ------------------------------------
+    "checkpoint_flush": ("seconds", "ok"),
+    "phase": ("name", "seconds"),               # streamed per phase exit
+    "phase_stats": ("name", "calls", "total", "min", "mean", "max"),
+    "trace_capture": ("phase", "dir"),
+    "warning": ("message",),
+}
+
+
+def validate_event(ev, prev_seq=None):
+    """Errors (list of strings) for one decoded event dict."""
+    errors = []
+    if not isinstance(ev, dict):
+        return [f"event is not an object: {ev!r}"]
+    for f in BASE_FIELDS:
+        if f not in ev:
+            errors.append(f"missing base field {f!r}: {ev!r}")
+    name = ev.get("event")
+    if name is not None:
+        required = EVENTS.get(name)
+        if required is None:
+            errors.append(f"unknown event type {name!r}")
+        else:
+            for f in required:
+                if f not in ev:
+                    errors.append(f"{name}: missing required field {f!r}")
+    t = ev.get("t")
+    if t is not None and not isinstance(t, (int, float)):
+        errors.append(f"t is not a number: {t!r}")
+    seq = ev.get("seq")
+    if seq is not None:
+        if not isinstance(seq, int):
+            errors.append(f"seq is not an int: {seq!r}")
+        elif prev_seq is not None and seq <= prev_seq:
+            errors.append(f"seq not increasing: {seq} after {prev_seq}")
+    return errors
+
+
+def validate_events(events):
+    """Validate a decoded event stream (one run's ledger file).
+
+    Checks every event against the schema, that ``seq`` increases
+    strictly (one run = one total order even with multi-threaded
+    emitters), and that the stream is bracketed by ``run_start`` /
+    ``run_end`` when non-empty.  Returns a list of error strings —
+    empty means the ledger is well-formed.
+    """
+    errors = []
+    prev_seq = None
+    for i, ev in enumerate(events):
+        for e in validate_event(ev, prev_seq=prev_seq):
+            errors.append(f"event {i}: {e}")
+        if isinstance(ev, dict) and isinstance(ev.get("seq"), int):
+            prev_seq = ev["seq"]
+    if events:
+        first = events[0].get("event") if isinstance(events[0], dict) else None
+        last = events[-1].get("event") if isinstance(events[-1], dict) else None
+        if first != "run_start":
+            errors.append(f"stream does not start with run_start (got {first!r})")
+        if last != "run_end":
+            errors.append(f"stream does not end with run_end (got {last!r})")
+    return errors

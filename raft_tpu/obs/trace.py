@@ -1,0 +1,51 @@
+"""On-demand ``jax.profiler`` trace capture around sweep phases.
+
+The run ledger answers "where did the seconds go" at phase granularity;
+when a phase itself needs kernel-level attribution (XLA op timeline,
+TPU step breakdown), arm ``RAFT_TPU_TRACE=dir`` and the phases named in
+``RAFT_TPU_TRACE_PHASES`` (default: ``chunks``) are wrapped in
+``jax.profiler.trace`` — the capture lands under ``dir`` for
+TensorBoard/Perfetto, and a ``trace_capture`` event in the ledger ties
+the capture directory to the run id.
+
+Capture is per-phase and re-entrancy-guarded: ``jax.profiler.trace``
+cannot nest, so an inner armed phase inside an already-captured outer
+phase is skipped rather than raised on.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import os
+import threading
+
+from ..config import obs_config
+from . import ledger
+
+__all__ = ["maybe_trace"]
+
+_active = threading.local()
+
+
+@contextlib.contextmanager
+def maybe_trace(phase: str):
+    """Wrap the body in ``jax.profiler.trace`` when capture is armed
+    for ``phase`` (no-op otherwise — the off path reads one env-derived
+    config dict and yields)."""
+    cfg = obs_config()
+    tdir = cfg["trace_dir"]
+    phases = cfg["trace_phases"]
+    if (tdir is None or (phases and phase not in phases)
+            or getattr(_active, "on", False)):
+        yield
+        return
+    import jax
+
+    os.makedirs(tdir, exist_ok=True)
+    ledger.emit("trace_capture", phase=phase, dir=tdir)
+    _active.on = True
+    try:
+        with jax.profiler.trace(tdir):
+            yield
+    finally:
+        _active.on = False

@@ -127,6 +127,12 @@ def design_params(fowt, include_aero=True, device=None):
                  if isinstance(v, bool)}
         for k in flags:
             del params["nodes"][k]
+        from ..obs import ledger as obs_ledger
+
+        if obs_ledger.current_run().enabled:
+            obs_ledger.emit("transfer", direction="h2d",
+                            bytes=obs_ledger.tree_nbytes(params),
+                            what="design_params")
         params = jax.device_put(params, device)
         params["nodes"].update(flags)
     return params, {"mcf": mcf, "nw": fowt.nw, "depth": fowt.depth,

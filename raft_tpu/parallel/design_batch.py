@@ -41,8 +41,11 @@ import jax.numpy as jnp
 
 from ..mooring import system as moorsys
 from ..analysis.contracts import shape_contract
+from ..obs import log as obs_log
 from ..ops import transforms
 from ..structure import member as mstruct
+
+_LOG = obs_log.get_logger("parallel.design_batch")
 
 
 def set_in_design(design, path, value):
@@ -197,7 +200,8 @@ def stack_variants(base_design, axes, combos, rho, g, x_ref=0.0, y_ref=0.0,
 
     if conflict:
         if display:
-            print("sweep: cross-axis leaf interaction detected; parsing every combination")
+            obs_log.display(_LOG, "sweep: cross-axis leaf interaction "
+                                  "detected; parsing every combination")
         all_leaves = [parse_combo(c) for c in combos]
         stacked = [np.stack([lv[il] for lv in all_leaves]) for il in range(len(leaves0))]
         return stacked, treedef, aero_axes
@@ -236,7 +240,8 @@ def stack_variants(base_design, axes, combos, rho, g, x_ref=0.0, y_ref=0.0,
                  for il in range(len(ref)))
         if not ok:
             if display:
-                print("sweep: probe assembly failed a spot check; parsing every combination")
+                obs_log.display(_LOG, "sweep: probe assembly failed a spot "
+                                      "check; parsing every combination")
             all_leaves = [parse_combo(c) for c in combos]
             stacked = [np.stack([lv[il] for lv in all_leaves]) for il in range(len(leaves0))]
             return stacked, treedef, aero_axes

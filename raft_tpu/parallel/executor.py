@@ -37,11 +37,15 @@ changes a traced program — results are bit-identical across settings.
 from __future__ import annotations
 
 import threading
-import warnings
+import time
 
 import jax
 
+from ..obs import log as obs_log
+
 __all__ = ["gather_rows", "start_host_fetch", "CheckpointWriter"]
+
+_LOG = obs_log.get_logger("parallel.executor")
 
 
 @jax.jit
@@ -89,10 +93,16 @@ class CheckpointWriter:
     ``state`` snapshots must be immutable from the submitter's side
     (the sweep hands over copies of its result arrays): the writer
     serializes them at an arbitrary later time.
+
+    ``on_write`` (optional) observes every write attempt as
+    ``on_write(seconds, error_or_None)`` from the writer thread — the
+    run ledger's ``checkpoint_flush`` hook.  Observer exceptions are
+    swallowed (telemetry never breaks persistence).
     """
 
-    def __init__(self, write_fn, name="raft-ckpt-writer"):
+    def __init__(self, write_fn, name="raft-ckpt-writer", on_write=None):
         self._write = write_fn
+        self._on_write = on_write
         self._cond = threading.Condition()
         self._pending = None
         self._closing = False
@@ -126,14 +136,23 @@ class CheckpointWriter:
                 state, self._pending = self._pending, None
                 if state is None:  # closing with nothing left to write
                     return
+            err = None
+            t0 = time.perf_counter()
             try:
                 with profiling.phase("checkpoint_write"):
                     self._write(state)
             except Exception as e:  # noqa: BLE001 - surfaced at close()
+                err = e
                 with self._cond:
                     self._error = e
             with self._cond:
                 self._writes += 1
+            if self._on_write is not None:
+                try:
+                    self._on_write(time.perf_counter() - t0, err)
+                except Exception:  # noqa: BLE001 - observer must not break writes
+                    _LOG.warning("checkpoint on_write observer failed",
+                                 exc_info=True)
 
     def close(self) -> None:
         """Flush the final snapshot, stop the thread, warn on failure."""
@@ -142,7 +161,8 @@ class CheckpointWriter:
             self._cond.notify_all()
         self._thread.join()
         if self._error is not None:
-            warnings.warn(
+            obs_log.warn(
+                _LOG,
                 f"sweep: background checkpoint write failed "
                 f"({type(self._error).__name__}: {self._error}); the "
                 "on-disk checkpoint may lag the returned results",

@@ -6,7 +6,8 @@ Usage::
     from raft_tpu import profiling
     with profiling.phase("statics"):
         ...
-    profiling.report()        # dict of {phase: seconds}
+    profiling.report()        # dict of {phase: seconds} (stable shape)
+    profiling.stats()         # {phase: {calls,total,min,mean,max}}
     profiling.summary()       # printable table, reset with reset()
 
 Timers nest (inner phases are recorded under "outer/inner") and are
@@ -19,10 +20,17 @@ the main thread's "sweep/..." hierarchy (a shared stack would both
 corrupt the names and pop other threads' frames).  Each thread's phases
 nest only within that thread.
 
-For kernel-level profiling use ``jax.profiler.trace`` around a phase;
-this module deliberately stays dependency-free so it also times
-host-side stages (YAML parsing, mesh generation, table builds) the JAX
-profiler cannot see.
+Listeners (:func:`add_listener`) observe every phase exit with
+``(full_name, seconds)`` — the bridge the run ledger
+(:mod:`raft_tpu.obs.ledger`) uses to stream phase records into a
+sweep's event file.  With no listeners registered the exit path does
+one empty-tuple check, so the ledger-off sweep pays nothing.
+
+For kernel-level profiling use ``jax.profiler.trace`` around a phase
+(``RAFT_TPU_TRACE``, see :mod:`raft_tpu.obs.trace`); this module
+deliberately stays dependency-free so it also times host-side stages
+(YAML parsing, mesh generation, table builds) the JAX profiler cannot
+see.
 """
 
 from __future__ import annotations
@@ -34,6 +42,9 @@ from collections import defaultdict
 
 _times: dict[str, float] = defaultdict(float)
 _counts: dict[str, int] = defaultdict(int)
+_min: dict[str, float] = {}
+_max: dict[str, float] = {}
+_listeners: tuple = ()
 _lock = threading.Lock()
 _tls = threading.local()
 
@@ -61,10 +72,42 @@ def phase(name: str):
         with _lock:
             _times[full] += dt
             _counts[full] += 1
+            if full not in _min or dt < _min[full]:
+                _min[full] = dt
+            if full not in _max or dt > _max[full]:
+                _max[full] = dt
+            listeners = _listeners
+        for fn in listeners:
+            try:
+                fn(full, dt)
+            except Exception:  # noqa: BLE001 - observers never kill timed code
+                import logging
+
+                logging.getLogger("raft_tpu.profiling").warning(
+                    "phase listener %r failed for %s", fn, full, exc_info=True)
+
+
+def add_listener(fn) -> None:
+    """Register ``fn(full_name, seconds)`` to observe every phase exit
+    (any thread).  Exceptions from listeners are logged, not raised."""
+    global _listeners
+    with _lock:
+        _listeners = _listeners + (fn,)
+
+
+def remove_listener(fn) -> None:
+    """Unregister a listener (no-op if absent)."""
+    global _listeners
+    with _lock:
+        _listeners = tuple(f for f in _listeners if f is not fn)
 
 
 def report() -> dict[str, float]:
-    """Accumulated seconds per phase."""
+    """Accumulated seconds per phase.
+
+    The ``{phase: seconds}`` shape is a stable contract — bench detail
+    and tests consume it; per-call statistics live in :func:`stats`.
+    """
     with _lock:
         return dict(_times)
 
@@ -74,21 +117,43 @@ def counts() -> dict[str, int]:
         return dict(_counts)
 
 
+def stats() -> dict[str, dict]:
+    """Per-phase call statistics:
+    ``{phase: {calls, total, min, mean, max}}`` (seconds)."""
+    with _lock:
+        return {k: {"calls": _counts[k], "total": _times[k],
+                    "min": _min[k], "mean": _times[k] / _counts[k],
+                    "max": _max[k]}
+                for k in _times}
+
+
 def reset() -> None:
     with _lock:
         _times.clear()
         _counts.clear()
+        _min.clear()
+        _max.clear()
 
 
 def summary() -> str:
-    """Aligned table of phases, call counts, and accumulated seconds."""
-    with _lock:
-        times = dict(_times)
-        cnt = dict(_counts)
-    if not times:
+    """Aligned table: phase, calls, total seconds, per-call min/mean/max,
+    and share of the total (top-level phases define 100%)."""
+    st = stats()
+    if not st:
         return "(no phases recorded)"
-    width = max(len(k) for k in times)
-    lines = [f"{'phase':<{width}}  {'calls':>6}  {'seconds':>9}"]
-    for k in sorted(times, key=times.get, reverse=True):
-        lines.append(f"{k:<{width}}  {cnt[k]:>6}  {times[k]:>9.3f}")
+    # %-of-total against the top-level (unnested) phases only: nested
+    # phases are contained in their parents, so summing every row would
+    # double-count
+    root_total = sum(v["total"] for k, v in st.items() if "/" not in k)
+    if root_total <= 0.0:
+        root_total = sum(v["total"] for v in st.values()) or 1.0
+    width = max(len(k) for k in st)
+    lines = [f"{'phase':<{width}}  {'calls':>6}  {'total_s':>9}  "
+             f"{'min_s':>8}  {'mean_s':>8}  {'max_s':>8}  {'%':>6}"]
+    for k in sorted(st, key=lambda k: st[k]["total"], reverse=True):
+        v = st[k]
+        lines.append(
+            f"{k:<{width}}  {v['calls']:>6}  {v['total']:>9.3f}  "
+            f"{v['min']:>8.4f}  {v['mean']:>8.4f}  {v['max']:>8.4f}  "
+            f"{100.0 * v['total'] / root_total:>5.1f}%")
     return "\n".join(lines)

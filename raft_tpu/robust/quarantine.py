@@ -18,11 +18,14 @@ mechanism.
 
 from __future__ import annotations
 
-import warnings
-
 import numpy as np
 
+from ..obs import ledger as obs_ledger
+from ..obs import log as obs_log
+
 __all__ = ["run_isolated"]
+
+_LOG = obs_log.get_logger("robust.quarantine")
 
 
 def _merge(parts, idx_parts, n_rows):
@@ -97,20 +100,26 @@ def _run_isolated(run, idx, retries=1, display=0, _depth=0):
             return run(idx), np.zeros(n, dtype=bool)
         except Exception as e:  # noqa: BLE001 - isolation boundary
             last_err = e
-            if attempt < retries and display:
-                print(f"sweep: chunk of {n} design(s) raised "
-                      f"{type(e).__name__}; retrying once")
+            if attempt < retries:
+                obs_ledger.emit("quarantine_retry", n=int(n))
+                if display:
+                    obs_log.display(
+                        _LOG, f"sweep: chunk of {n} design(s) raised "
+                              f"{type(e).__name__}; retrying once")
 
     if n == 1:
-        warnings.warn(
+        obs_log.warn(
+            _LOG,
             f"sweep: design index {int(idx[0])} quarantined after "
             f"{type(last_err).__name__}: {last_err}",
             RuntimeWarning, stacklevel=2)
         return None, np.ones(1, dtype=bool)
 
+    obs_ledger.emit("quarantine_bisect", n=int(n))
     if display:
-        print(f"sweep: chunk of {n} design(s) still failing "
-              f"({type(last_err).__name__}); bisecting to isolate")
+        obs_log.display(
+            _LOG, f"sweep: chunk of {n} design(s) still failing "
+                  f"({type(last_err).__name__}); bisecting to isolate")
     mid = n // 2
     halves = [idx[:mid], idx[mid:]]
     parts, masks = [], []

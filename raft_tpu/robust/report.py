@@ -78,6 +78,10 @@ def build_report(status, combos=None, axes=None, health=None):
         report["worst_cond"] = [
             {"design": int(i), "cond": float(cond[i])}
             for i in order_c[:_TOP_K] if np.isfinite(cond[i])]
+
+    from ..obs import ledger as obs_ledger
+
+    obs_ledger.emit("health_report", counts=counts)
     return report
 
 

@@ -24,6 +24,7 @@ from __future__ import annotations
 
 import copy
 import itertools
+import time
 import warnings
 import zipfile
 
@@ -35,6 +36,9 @@ from . import profiling
 from .analysis.contracts import shape_contract
 from .config import executor_config, health_config
 from .core.model import Model
+from .obs import ledger as obs_ledger
+from .obs import log as obs_log
+from .obs.trace import maybe_trace
 from .ops import waves
 from .parallel.design_batch import (SweepAxisError, pack_rows, pack_spec,
                                     set_in_design, stack_variants,
@@ -44,9 +48,11 @@ from .parallel.executor import (CheckpointWriter, gather_rows,
 from .robust import (STATUS_NAN, STATUS_OK, STATUS_QUARANTINED, SolveHealth,
                      build_report, classify_health, format_report,
                      run_isolated)
-from .robust.health import reduce_design_status
+from .robust.health import STATUS_NAMES, reduce_design_status
 
 __all__ = ["sweep", "set_in_design", "case_aero_params"]
+
+_LOG = obs_log.get_logger("sweep")
 
 # Test seam for fault-injection: when set, called as
 # ``hook(idx, dispatch)`` in place of the chunk dispatch (``idx`` is the
@@ -272,7 +278,51 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
     structured end-of-sweep summary, printed when ``display``).  Feed
     the result to :func:`raft_tpu.sweep_post.plot_sweep_contours` for
     the reference-style contour figures (parametersweep.py:119-561).
+
+    Observability: with ``RAFT_TPU_LEDGER=dir`` set, the whole call is
+    bracketed in a run-ledger run (:mod:`raft_tpu.obs`) — a JSON-lines
+    event file records the template/compile cache story, per-chunk
+    dispatch/fetch/commit with pipeline depth, transfer bytes,
+    quarantine activity, and per-phase timings, keyed by a run id and a
+    design-batch fingerprint.  Ledger unset (the default) takes the
+    zero-instrumentation path: no events, no listeners, bit-identical
+    results and zero additional XLA compiles.
     """
+    if devices is not None:
+        devices = list(devices)
+    run = obs_ledger.NULL_RUN
+    if obs_ledger.enabled():
+        n_designs = 1
+        for _, v in axes:
+            n_designs *= len(v)
+        run = obs_ledger.start_run(
+            "sweep",
+            fingerprint={"design": _design_hash(base_design)[:16],
+                         "axes": [str(p) for p, _ in axes],
+                         "n_designs": n_designs,
+                         "n_cases": len(sea_states)},
+            meta={"n_iter": int(n_iter), "chunk_size": int(chunk_size),
+                  "wind": wind is not None,
+                  "n_devices": len(devices) if devices is not None else 1})
+    try:
+        out = _sweep_impl(base_design, axes, sea_states, n_iter=n_iter,
+                          device=device, display=display,
+                          checkpoint=checkpoint, chunk_size=chunk_size,
+                          wind=wind, devices=devices, health=health, run=run)
+        run.finish(ok=True, counts=out["report"]["counts"])
+        return out
+    except BaseException as e:
+        run.finish(ok=False, error=f"{type(e).__name__}: {e}")
+        raise
+    finally:
+        run.close()
+
+
+def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
+                checkpoint, chunk_size, wind, devices, health, run):
+    """:func:`sweep` body; ``run`` is the active ledger run (NULL_RUN
+    when telemetry is off — every ``run.emit`` is then a no-op and all
+    byte/stat collection is gated behind ``run.enabled``)."""
     import os
 
     from .parallel.case_solve import make_parametric_solver
@@ -343,9 +393,10 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                         if "health_cond" in dat and dat["health_cond"].shape == health_cond.shape:
                             health_cond = np.array(dat["health_cond"])
                         if display:
-                            print(f"sweep resume: {int(done.sum())}/{n_designs} designs already done")
+                            obs_log.display(_LOG, f"sweep resume: {int(done.sum())}/{n_designs} designs already done")
             except (zipfile.BadZipFile, ValueError, KeyError, OSError, EOFError) as e:
-                warnings.warn(
+                obs_log.warn(
+                    _LOG,
                     f"sweep: checkpoint {checkpoint!r} unreadable "
                     f"({type(e).__name__}: {e}); starting fresh",
                     RuntimeWarning)
@@ -360,7 +411,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         out["report"] = build_report(status, combos=combos, axes=axes,
                                      health=out["health"])
         if display:
-            print(format_report(out["report"]))
+            obs_log.display(_LOG, format_report(out["report"]))
         return out
 
     if done.all():
@@ -372,6 +423,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
     # a full setPosition here would just pay their jit compiles twice.
     memo_key = _template_key(base_design, n_iter, wind is not None)
     memo = _TEMPLATE_MEMO.get(memo_key)
+    run.emit("template_build", cache="hit" if memo is not None else "miss")
     if memo is not None:
         model, fowt = memo["model"], memo["fowt"]
     else:
@@ -437,9 +489,11 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 f"axis set falls outside it ({e}). Sweep site/topology axes "
                 "without `wind`, or via the full Model per point.") from e
         if display:
-            print(f"sweep: falling back to per-variant model path ({e})")
+            obs_log.display(_LOG, f"sweep: falling back to per-variant model path ({e})")
 
     if stacked is not None:
+        run.emit("stack_build",
+                 cache="hit" if cached_stack is not None else "miss")
         spec = pack_spec(stacked)
         n_leaves = len(stacked)
         zetas, betas = _sea_state_waves(fowt, sea_states)
@@ -464,8 +518,10 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                     av_combos.append(c)
                 aero_idx[ic] = av_map[key]
             if display:
-                print(f"sweep: {len(av_combos)} turbine variants along aero axes "
-                      f"{[str(axes[ia][0]) for ia in aero_axes]}")
+                obs_log.display(
+                    _LOG,
+                    f"sweep: {len(av_combos)} turbine variants along aero axes "
+                    f"{[str(axes[ia][0]) for ia in aero_axes]}")
 
         mode = ("sel_wind" if aero_axes and wind is not None
                 else "sel" if aero_axes
@@ -490,11 +546,20 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                       if run_health else (False,))
         jit_key = (mode, place_sig, chunk_size, n_cases, len(av_combos),
                    health_sig)
+        ecfg = executor_config()
+        pipeline_depth = max(1, int(ecfg["pipeline_depth"]))
+        run.emit("plan", mode=mode, n_chunks=-(-n_designs // chunk_size),
+                 chunk_size=chunk_size, pipeline_depth=pipeline_depth,
+                 resident=bool(ecfg["resident"] and mesh is None))
         if (memo is not None and memo["treedef"] == treedef
                 and memo.get("spec") == spec):
             jitted = memo["jitted"].get(jit_key)
         else:
             jitted = None
+        if jitted is not None:
+            # repeat sweep: both chunk executables come straight from the
+            # in-process template memo — no lowering, no XLA
+            run.emit("compile_cache", cache="hit")
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         if mesh is not None:
@@ -515,6 +580,18 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         betas = put_c(betas)
 
         threads = []
+        compile_sentinel = None
+        compile_times: dict = {}
+        if jitted is None and run.enabled:
+            # XLA cost accounting: count backend compiles while the AOT
+            # build runs, so compile_end events can tell a real compile
+            # from a persistent-cache deserialization.  Only armed when
+            # the ledger is on — the sentinel hooks jax logging/monitoring
+            # and the off path must not touch global state.
+            from .analysis.recompile import RecompileSentinel
+
+            compile_sentinel = RecompileSentinel()
+            compile_sentinel.__enter__()
         if jitted is None:
             # ---- split-program AOT build.  The chunk work is two XLA
             # programs instead of one fused jit:
@@ -687,7 +764,9 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
 
             def _compile(key, lowered, dummy_args_fn):
                 try:
+                    t0 = time.perf_counter()
                     compiled = lowered.compile()
+                    compile_times[key] = time.perf_counter() - t0
                     built[key] = compiled
                     if warm_exec:
                         # warm-exec is best-effort — the real chunk call
@@ -715,6 +794,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 def dummyA():
                     return (_zeros_like_sds(packed_sds, put_d),)
 
+            run.emit("compile_start", key="A")
             tA = threading.Thread(target=_compile, args=("A", lA, dummyA),
                                   daemon=True)
             tA.start()
@@ -754,6 +834,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                         put_d(np.zeros((chunk_size,), np.int32)))
 
             lB = _lower(jB, *argsB)
+            run.emit("compile_start", key="B")
             tB = threading.Thread(target=_compile, args=("B", lB, dummyB),
                                   daemon=True)
             tB.start()
@@ -791,6 +872,18 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             with profiling.phase("sweep/compile_wait"):
                 for t in threads:
                     t.join()
+            if compile_sentinel is not None:
+                compile_sentinel.__exit__(None, None, None)
+                for key, fname in (("A", "partA"), ("B", "partB")):
+                    # log-derived names wrap the function ("jit(partA)")
+                    n_xla = sum(
+                        v for k, v in
+                        compile_sentinel.compiles_by_name.items()
+                        if fname in k)
+                    run.emit("compile_end", key=key,
+                             seconds=compile_times.get(key),
+                             cache="miss" if n_xla else "hit",
+                             xla_compiles=n_xla)
             cA, cB = built.get("A"), built.get("B")
             # surfaced unconditionally: a failed warm run usually means
             # every chunk pays the upload cost it was meant to hide, and
@@ -799,16 +892,18 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 msg = (f"sweep: warm-exec of part {key} failed "
                        f"({type(err).__name__}: {err}); first chunk "
                        "will pay executable initialization")
-                warnings.warn(msg, RuntimeWarning)
+                obs_log.warn(_LOG, msg, RuntimeWarning)
                 if display:
-                    print(msg)
+                    obs_log.display(_LOG, msg)
             if isinstance(cA, Exception) or isinstance(cB, Exception):
                 # AOT failed (e.g. an exotic sharding/backend combination):
                 # fall back to the plain jits, which compile inline at the
                 # first chunk call
                 if display:
-                    print(f"sweep: AOT compile failed ({cA!r} / {cB!r}); "
-                          "falling back to inline jit")
+                    obs_log.display(
+                        _LOG,
+                        f"sweep: AOT compile failed ({cA!r} / {cB!r}); "
+                        "falling back to inline jit")
                 cA, cB = jA, jB
             jitted = (cA, cB)
             entry = _TEMPLATE_MEMO.get(memo_key)
@@ -844,8 +939,6 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         # nothing.  Disabled on the mesh path: a design-sharded gather by
         # arbitrary global indices would need collectives, and the mesh
         # path's per-chunk transfers are already split across chips.
-        ecfg = executor_config()
-        pipeline_depth = max(1, int(ecfg["pipeline_depth"]))
         resident = None
         if ecfg["resident"] and mesh is None:
             rkey = (stack_key, place_sig) if stack_key is not None else None
@@ -860,6 +953,12 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 with profiling.phase("sweep/resident_upload"):
                     resident = [put_d(b) for b in
                                 pack_rows(stacked, spec, np.arange(n_designs))]
+                if run.enabled:
+                    run.emit("transfer", direction="h2d",
+                             bytes=obs_ledger.tree_nbytes(resident),
+                             what="resident_batch")
+                    obs_ledger.emit_device_memory(run, device=device,
+                                                  what="resident_upload")
                 if rcache is not None:
                     while len(rcache) >= 2:
                         rcache.pop(next(iter(rcache)))
@@ -873,7 +972,10 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         ckpt_writer = None
         if checkpoint:
             ckpt_writer = CheckpointWriter(
-                lambda st: _save_checkpoint(checkpoint, sig, *st))
+                lambda st: _save_checkpoint(checkpoint, sig, *st),
+                on_write=(lambda secs, err: run.emit(
+                    "checkpoint_flush", seconds=secs, ok=err is None))
+                if run.enabled else None)
 
         def _submit_ckpt():
             # snapshot copies: the writer serializes at an arbitrary
@@ -883,7 +985,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                                 nacelle_acc.copy(), status.copy(),
                                 health_resid.copy(), health_cond.copy()))
 
-        with profiling.phase("sweep/chunks"):
+        with profiling.phase("sweep/chunks"), maybe_trace("chunks"):
             # software-pipelined with bounded depth: chunk k+1's gather
             # and executables are queued before chunk k's results are
             # fetched, hiding the host->device->host round trips behind
@@ -968,6 +1070,15 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                     health_cond[rows_idx] = np.min(hb_rows["cond"], axis=-1)
                 status[rows_idx] = _classify_rows(rows_idx, std_rows,
                                                   a_std_rows, hb_rows)
+                if run.enabled:
+                    st_rows = status[rows_idx]
+                    for code in np.unique(st_rows):
+                        if code != STATUS_OK:
+                            run.emit(
+                                "status_transition",
+                                designs=[int(i) for i
+                                         in rows_idx[st_rows == code]],
+                                to=STATUS_NAMES.get(int(code), "?"))
                 done[rows_idx] = True
                 if ckpt_writer is not None:
                     _submit_ckpt()
@@ -982,11 +1093,26 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                     std_rows = np.asarray(std)[:n_real]
                     a_std_rows = np.asarray(a_std)[:n_real]
                     pr_rows = {k: np.asarray(pr[k])[:n_real] for k in props}
+                if run.enabled:
+                    nb = (std_rows.nbytes + a_std_rows.nbytes
+                          + sum(v.nbytes for v in pr_rows.values())
+                          + (sum(v.nbytes for v in hb_rows.values())
+                             if hb_rows is not None else 0))
+                    run.emit("chunk_fetch", chunk=start // chunk_size,
+                             bytes=int(nb))
                 with profiling.phase("commit"):
                     _store_rows(np.arange(start, stop), std_rows, a_std_rows,
                                 pr_rows, hb_rows)
+                if run.enabled:
+                    n_done = int(done.sum())
+                    run.emit("chunk_commit", chunk=start // chunk_size,
+                             done=n_done, n_designs=n_designs,
+                             eta_s=run.elapsed() * (n_designs - n_done)
+                             / max(n_done, 1))
                 if display:
-                    print(f"sweep: designs {start+1}-{stop}/{n_designs} done")
+                    obs_log.display(
+                        _LOG,
+                        f"sweep: designs {start+1}-{stop}/{n_designs} done")
 
             def _exec_rows(sub_idx):
                 """Quarantine-runner callable: arbitrary-length design
@@ -1011,7 +1137,10 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 """A chunk raised (dispatch or fetch): re-run it through
                 the retry-then-bisect runner so only the poison designs
                 are lost."""
-                warnings.warn(
+                run.emit("chunk_fault", start=start, stop=stop,
+                         error=f"{type(err).__name__}: {err}")
+                obs_log.warn(
+                    _LOG,
                     f"sweep: chunk {start}-{stop} raised "
                     f"({type(err).__name__}: {err}); isolating faults",
                     RuntimeWarning)
@@ -1029,12 +1158,19 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                                 {k: merged[f"prop_{k}"][ok] for k in props},
                                 hb_rows)
                 status[rows_idx[quarantined]] = STATUS_QUARANTINED
+                if run.enabled and quarantined.any():
+                    bad = [int(i) for i in rows_idx[quarantined]]
+                    run.emit("design_quarantined", designs=bad)
+                    run.emit("status_transition", designs=bad,
+                             to=STATUS_NAMES.get(int(STATUS_QUARANTINED), "?"))
                 done[rows_idx] = True
                 if ckpt_writer is not None:
                     _submit_ckpt()
                 if display:
-                    print(f"sweep: designs {start+1}-{stop}/{n_designs} done "
-                          f"({int(quarantined.sum())} quarantined)")
+                    obs_log.display(
+                        _LOG,
+                        f"sweep: designs {start+1}-{stop}/{n_designs} done "
+                        f"({int(quarantined.sum())} quarantined)")
 
             def _safe_commit(entry):
                 # dispatch is async: a poison chunk often raises only at
@@ -1057,6 +1193,9 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                     n_real = stop - start
                     idx = np.arange(start, start + chunk_size)
                     idx[n_real:] = stop - 1
+                    run.emit("chunk_dispatch", chunk=start // chunk_size,
+                             start=start, stop=stop, n_real=n_real,
+                             in_flight=len(pending) + 1)
                     try:
                         entry = (start, stop, n_real) + _dispatch(idx)
                     except Exception as e:  # noqa: BLE001 - isolation boundary
@@ -1074,9 +1213,14 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                 # synchronous saves)
                 if ckpt_writer is not None:
                     ckpt_writer.close()
+        if run.enabled:
+            obs_ledger.emit_device_memory(run, device=device,
+                                          what="post_chunks")
         return _finalize()
 
     # ----- fallback: per-variant model compile, batched device solve -----
+    run.emit("plan", mode="fallback", n_chunks=-(-n_designs // chunk_size),
+             chunk_size=chunk_size)
     zetas, betas = _sea_state_waves(fowt, sea_states)
     aero = case_aero_params(fowt, wind) if wind is not None else None
     batched = None
@@ -1095,17 +1239,20 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
             try:
                 p, static, template = _compile_variant(base_design, axes, combos[ic], device)
             except Exception as e:  # noqa: BLE001 - isolation boundary
-                warnings.warn(
+                obs_log.warn(
+                    _LOG,
                     f"sweep: design {ic} {combos[ic]!r} failed to build "
                     f"({type(e).__name__}: {e}); quarantined",
                     RuntimeWarning)
                 status[ic] = STATUS_QUARANTINED
+                run.emit("design_quarantined", designs=[int(ic)])
                 done[ic] = True
                 continue
             params_list.append(p)
             row_idx.append(ic)
             if display:
-                print(f"compiled design {ic+1}/{n_designs}: {combos[ic]}")
+                obs_log.display(
+                    _LOG, f"compiled design {ic+1}/{n_designs}: {combos[ic]}")
         if not params_list:
             continue
         n_real = len(params_list)

@@ -254,6 +254,44 @@ def test_f64_literal_quiet_outside_kernel_dirs_and_when_gated():
 
 
 # ---------------------------------------------------------------------------
+# GL-PRINT
+# ---------------------------------------------------------------------------
+
+_PRINT_SRC = """
+    def f(x, display=False):
+        if display:
+            print("progress", x)
+        return x
+    """
+
+
+def test_print_fires_in_library_code():
+    assert _rules(_PRINT_SRC, relpath="raft_tpu/core/fake.py") == ["GL-PRINT"]
+
+
+def test_print_exempt_suffix_and_disable_directive():
+    import textwrap as _tw
+
+    from raft_tpu.analysis.graftlint import Config
+
+    # CLI/report modules listed in [lint] print_exempt are skipped whole
+    cfg = Config(print_exempt=("raft_tpu/obs/report.py",))
+    vs = lint_source(_tw.dedent(_PRINT_SRC), cfg=cfg,
+                     relpath="raft_tpu/obs/report.py")
+    assert vs == []
+    # ...but the same config still flags non-exempt files
+    vs = lint_source(_tw.dedent(_PRINT_SRC), cfg=cfg,
+                     relpath="raft_tpu/core/fake.py")
+    assert [v.rule for v in vs] == ["GL-PRINT"]
+    # per-line opt-out for the sanctioned funnel print
+    rules = _rules("""
+        def display_funnel(message):
+            print(message)  # graftlint: disable=GL-PRINT
+        """, relpath="raft_tpu/obs/fake_log.py")
+    assert rules == []
+
+
+# ---------------------------------------------------------------------------
 # GL-NESTED-JIT
 # ---------------------------------------------------------------------------
 

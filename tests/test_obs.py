@@ -1,0 +1,368 @@
+"""Run-ledger telemetry (raft_tpu.obs): schema, sweep event streams,
+report CLI, and the zero-overhead-off contract.
+
+The observability layer's contract mirrors the executor's: arming
+RAFT_TPU_LEDGER changes what gets RECORDED, never what gets computed —
+ledger-on and ledger-off sweeps must be bit-identical with zero extra
+XLA compiles.  The event stream itself must be schema-valid, totally
+ordered (seq), complete (every dispatched chunk commits), and must
+capture the fault/quarantine narrative when a chunk dies.
+"""
+
+import json
+import logging
+
+import numpy as np
+import pytest
+
+from raft_tpu import sweep as sweep_mod
+from raft_tpu.designs import demo_spar
+from raft_tpu.obs import ledger as obs_ledger
+from raft_tpu.obs import log as obs_log
+from raft_tpu.obs import report as obs_report
+from raft_tpu.obs import schema as obs_schema
+from raft_tpu.robust import STATUS_OK, STATUS_QUARANTINED
+
+AXES = [("platform.members.0.d",
+         [[9.4, 9.4, 6.5, 6.5], [10.0, 10.0, 6.5, 6.5],
+          [10.5, 10.5, 6.5, 6.5], [11.0, 11.0, 6.5, 6.5]])]
+STATES = [(4.0, 8.0), (6.0, 10.0)]
+
+
+def _sweep(**kw):
+    kw.setdefault("n_iter", 8)
+    kw.setdefault("chunk_size", 2)
+    return sweep_mod.sweep(demo_spar(nw_freqs=(0.05, 0.4)), AXES, STATES, **kw)
+
+
+def _ledger_sweep(tmp_path, monkeypatch, name, **kw):
+    """Run one sweep with the ledger armed; return (out, events)."""
+    ldir = tmp_path / name
+    monkeypatch.setenv("RAFT_TPU_LEDGER", str(ldir))
+    out = _sweep(**kw)
+    monkeypatch.delenv("RAFT_TPU_LEDGER")
+    runs = obs_ledger.list_runs(str(ldir))
+    assert len(runs) == 1, runs
+    return out, obs_ledger.read_events(runs[0]), runs[0]
+
+
+def _names(events):
+    return [ev["event"] for ev in events]
+
+
+# ---------------------------------------------------------------------------
+# schema + ledger primitives (no sweep)
+# ---------------------------------------------------------------------------
+
+
+def test_run_roundtrip_is_schema_valid(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_LEDGER", str(tmp_path))
+    run = obs_ledger.start_run("test", fingerprint={"k": "v"},
+                               meta={"n": 3})
+    assert run.enabled and run.run_id
+    run.emit("plan", mode="resident", n_chunks=2, chunk_size=2)
+    run.emit("transfer", direction="h2d", bytes=1024, what="x")
+    # numpy scalars/arrays must serialize (emit happens under np types)
+    run.emit("chunk_commit", chunk=np.int64(0), done=np.int64(2),
+             n_designs=4, eta_s=np.float64(0.5))
+    run.finish(ok=True, counts={"ok": 4})
+
+    events = obs_ledger.read_events(run.path)
+    assert obs_schema.validate_events(events) == []
+    assert _names(events)[0] == "run_start"
+    assert _names(events)[-1] == "run_end"
+    assert events[0]["fingerprint"] == {"k": "v"}
+    assert events[-1]["ok"] is True
+    # seq is a strict total order
+    seqs = [ev["seq"] for ev in events]
+    assert seqs == sorted(seqs) and len(set(seqs)) == len(seqs)
+    # emits after close are dropped, not raised on
+    run.emit("plan", mode="late", n_chunks=1, chunk_size=1)
+    assert len(obs_ledger.read_events(run.path)) == len(events)
+
+
+def test_start_run_disabled_returns_null(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_LEDGER", raising=False)
+    run = obs_ledger.start_run("test")
+    assert run is obs_ledger.NULL_RUN and not run.enabled
+    run.emit("anything")  # all no-ops
+    run.finish(ok=True)
+    run.close()
+    assert obs_ledger.current_run() is obs_ledger.NULL_RUN
+
+
+def test_schema_rejects_malformed_streams():
+    ok = {"t": 1.0, "seq": 1, "event": "run_start",
+          "run_id": "r", "kind": "test"}
+    end = {"t": 2.0, "seq": 2, "event": "run_end", "ok": True}
+    assert obs_schema.validate_events([ok, end]) == []
+
+    errs = obs_schema.validate_events([ok, {"t": 1.5, "seq": 2,
+                                            "event": "nonsense"}, end])
+    assert any("unknown event" in e for e in errs)
+    # missing required field
+    errs = obs_schema.validate_events(
+        [ok, {"t": 1.5, "seq": 2, "event": "transfer"},
+         dict(end, seq=3)])
+    assert any("missing required field" in e for e in errs)
+    # seq must strictly increase
+    errs = obs_schema.validate_events([ok, dict(end, seq=1)])
+    assert any("seq not increasing" in e for e in errs)
+    # stream must be bracketed run_start .. run_end
+    errs = obs_schema.validate_events([ok])
+    assert any("does not end with run_end" in e for e in errs)
+
+
+def test_read_events_drops_truncated_tail(tmp_path):
+    p = tmp_path / "t.jsonl"
+    good = {"t": 1.0, "seq": 1, "event": "run_start",
+            "run_id": "r", "kind": "k"}
+    p.write_text(json.dumps(good) + "\n" + '{"t": 2.0, "seq": 2, "ev')
+    events = obs_ledger.read_events(str(p))
+    assert len(events) == 1 and events[0]["event"] == "run_start"
+
+
+# ---------------------------------------------------------------------------
+# sweep event streams
+# ---------------------------------------------------------------------------
+
+
+def test_sweep_ledger_lifecycle_and_report(tmp_path, monkeypatch, capsys):
+    out, events, path = _ledger_sweep(tmp_path, monkeypatch, "l1")
+    assert (out["status"] == STATUS_OK).all()
+    assert obs_schema.validate_events(events) == []
+    names = _names(events)
+
+    # lifecycle ordering
+    assert names[0] == "run_start" and names[-1] == "run_end"
+    for earlier, later in [("template_build", "plan"),
+                           ("plan", "chunk_dispatch"),
+                           ("chunk_dispatch", "chunk_commit"),
+                           ("chunk_commit", "health_report"),
+                           ("health_report", "run_end")]:
+        assert names.index(earlier) < names.index(later), (earlier, later)
+    # phase_stats are flushed at finish, before run_end
+    assert names.index("phase_stats") < names.index("run_end")
+
+    start = events[0]
+    assert start["kind"] == "sweep"
+    assert start["fingerprint"]["n_designs"] == 4
+    assert start["fingerprint"]["n_cases"] == len(STATES)
+    assert events[-1]["ok"] is True and events[-1]["counts"]["ok"] == 4
+
+    by = {}
+    for ev in events:
+        by.setdefault(ev["event"], []).append(ev)
+    plan = by["plan"][0]
+    assert plan["n_chunks"] == 2 and plan["chunk_size"] == 2
+    # every compile_start has a matching compile_end (or the memo hit)
+    if "compile_start" in by:
+        assert sorted(e["key"] for e in by["compile_start"]) == \
+            sorted(e["key"] for e in by["compile_end"])
+    else:
+        assert "compile_cache" in by
+    # health report counts agree with the sweep output
+    assert by["health_report"][0]["counts"]["ok"] == 4
+    # phase events streamed + aggregated
+    stat_names = {e["name"] for e in by["phase_stats"]}
+    assert any(n.startswith("sweep") for n in stat_names)
+    for e in by["phase_stats"]:
+        assert e["calls"] >= 1
+        assert e["min"] <= e["mean"] <= e["max"]
+
+    # the report CLI renders it and validates clean
+    assert obs_report.main([path, "--validate"]) == 0
+    text = capsys.readouterr().out
+    for section in ("phase waterfall", "compile vs execute",
+                    "data movement", "chunk pipeline", "health"):
+        assert section in text, section
+    assert events[0]["run_id"] in text
+
+
+def test_chunk_events_complete_across_pipeline_depths(tmp_path, monkeypatch):
+    """Every dispatched chunk must fetch and commit exactly once at any
+    pipeline depth, commits must account for all designs, and in_flight
+    must respect the depth cap."""
+    _sweep()  # warm the executables so both runs take the same path
+    for depth in (1, 3):
+        monkeypatch.setenv("RAFT_TPU_PIPELINE", str(depth))
+        _, events, _ = _ledger_sweep(tmp_path, monkeypatch, f"d{depth}")
+        assert obs_schema.validate_events(events) == []
+        by = {}
+        for ev in events:
+            by.setdefault(ev["event"], []).append(ev)
+
+        dispatches = by["chunk_dispatch"]
+        commits = by["chunk_commit"]
+        assert [e["chunk"] for e in dispatches] == [0, 1]
+        assert sorted(e["chunk"] for e in by["chunk_fetch"]) == [0, 1]
+        assert sorted(e["chunk"] for e in commits) == [0, 1]
+        assert sum(e["n_real"] for e in dispatches) == 4
+        assert max(e["done"] for e in commits) == 4
+        for e in commits:
+            assert e["eta_s"] >= 0.0
+        in_flight = [e["in_flight"] for e in dispatches]
+        assert max(in_flight) <= depth
+        if depth == 1:
+            assert in_flight == [1, 1]
+        # per-chunk ordering: dispatch(c) < fetch(c) < commit(c)
+        seq_of = lambda evs, c: next(e["seq"] for e in evs if e["chunk"] == c)
+        for c in (0, 1):
+            assert seq_of(dispatches, c) < seq_of(by["chunk_fetch"], c) \
+                < seq_of(commits, c)
+        # d2h movement was accounted (h2d transfer events only appear on
+        # a COLD resident upload; these warm sweeps hit the resident
+        # cache, so requiring one here would be wrong)
+        assert all(e["bytes"] > 0 for e in by["chunk_fetch"])
+    monkeypatch.delenv("RAFT_TPU_PIPELINE")
+
+
+def test_fault_injected_sweep_records_quarantine_narrative(
+        tmp_path, monkeypatch, capsys):
+    """ISSUE acceptance: a fault-injected 2-chunk sweep with the ledger
+    armed yields a renderable event log carrying the full fault ->
+    bisect -> quarantine -> status narrative."""
+    _sweep()  # warm
+    poison = 1
+
+    def hook(idx, dispatch):
+        if (np.asarray(idx) == poison).any():
+            raise RuntimeError("injected chunk fault")
+        return dispatch(idx)
+
+    monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", hook)
+    with pytest.warns(RuntimeWarning, match="isolating faults"):
+        out, events, path = _ledger_sweep(tmp_path, monkeypatch, "fault")
+    monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", None)
+
+    assert out["status"][poison] == STATUS_QUARANTINED
+    assert obs_schema.validate_events(events) == []
+    by = {}
+    for ev in events:
+        by.setdefault(ev["event"], []).append(ev)
+
+    fault = by["chunk_fault"][0]
+    assert fault["start"] == 0 and fault["stop"] == 2
+    assert "injected chunk fault" in fault["error"]
+    # the 2-design chunk is bisected, the poisoned design quarantined
+    assert by["quarantine_bisect"][0]["n"] == 2
+    assert by["design_quarantined"][0]["designs"] == [poison]
+    trans = {e["to"] for e in by["status_transition"]}
+    assert "quarantined" in trans
+    assert any("isolating faults" in e["message"]
+               for e in by["warning"])
+    assert by["health_report"][0]["counts"]["quarantined"] == 1
+    assert events[-1]["event"] == "run_end" and events[-1]["ok"] is True
+    # narrative ordering: fault before quarantine before the health rollup
+    names = _names(events)
+    assert names.index("chunk_fault") < names.index("design_quarantined") \
+        < names.index("health_report")
+
+    # the ledger renders (the whole point of a flight recorder)
+    assert obs_report.main([path]) == 0
+    text = capsys.readouterr().out
+    assert "quarantine" in text and "injected chunk fault" in text
+
+
+def test_run_end_records_failure(tmp_path, monkeypatch):
+    """A sweep that dies still closes its ledger with ok=false + error
+    (the crash-forensics contract)."""
+    _sweep()  # warm
+
+    def hook(idx, dispatch):
+        raise KeyboardInterrupt("operator abort")
+
+    monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", hook)
+    monkeypatch.setenv("RAFT_TPU_LEDGER", str(tmp_path / "dead"))
+    with pytest.raises(KeyboardInterrupt):
+        _sweep()
+    monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", None)
+    monkeypatch.delenv("RAFT_TPU_LEDGER")
+
+    runs = obs_ledger.list_runs(str(tmp_path / "dead"))
+    assert len(runs) == 1
+    events = obs_ledger.read_events(runs[0])
+    assert obs_schema.validate_events(events) == []
+    assert events[-1]["event"] == "run_end"
+    assert events[-1]["ok"] is False
+    assert "operator abort" in events[-1]["error"]
+    # no dangling active run leaks into the next sweep
+    assert obs_ledger.current_run() is obs_ledger.NULL_RUN
+
+
+# ---------------------------------------------------------------------------
+# zero-overhead-off: telemetry must not change results or compiles
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.sentinel
+def test_ledger_on_off_bit_identical_no_recompile(tmp_path, monkeypatch):
+    """ISSUE acceptance: sweeps with the ledger unset are bit-identical
+    to ledger-on sweeps, and arming telemetry compiles ZERO additional
+    XLA programs."""
+    from raft_tpu.analysis.recompile import RecompileSentinel
+
+    monkeypatch.delenv("RAFT_TPU_LEDGER", raising=False)
+    base = _sweep()  # warm: compiles + memoizes the executables
+
+    with RecompileSentinel() as s:
+        snap = s.snapshot()
+        off = _sweep()
+        s.assert_no_recompile(snap, "ledger-off sweep")
+        on, events, _ = _ledger_sweep(tmp_path, monkeypatch, "on")
+        s.assert_no_recompile(snap, "ledger-on sweep")
+
+    for a, b in ((base, off), (off, on)):
+        np.testing.assert_array_equal(a["motion_std"], b["motion_std"])
+        np.testing.assert_array_equal(a["AxRNA_std"], b["AxRNA_std"])
+        np.testing.assert_array_equal(a["status"], b["status"])
+    assert obs_schema.validate_events(events) == []
+    # the ledger-on run observed its (cache-hit) compile state honestly
+    assert any(n in ("compile_cache", "compile_end") for n in _names(events))
+
+
+# ---------------------------------------------------------------------------
+# logging funnel
+# ---------------------------------------------------------------------------
+
+
+def test_logger_records_stamp_run_id(tmp_path, monkeypatch):
+    logger = obs_log.get_logger("test.stamp")
+    records = []
+
+    class Capture(logging.Handler):
+        def emit(self, record):
+            records.append(record)
+
+    h = Capture()
+    logger.addHandler(h)
+    logger.setLevel(logging.INFO)
+    try:
+        logger.info("outside any run")
+        monkeypatch.setenv("RAFT_TPU_LEDGER", str(tmp_path))
+        run = obs_ledger.start_run("test")
+        logger.info("inside the run")
+        run.finish(ok=True)
+        logger.info("after close")
+    finally:
+        logger.removeHandler(h)
+
+    assert [r.run_id for r in records] == ["-", run.run_id, "-"]
+
+
+def test_warn_funnel_hits_all_three_channels(tmp_path, monkeypatch):
+    logger = obs_log.get_logger("test.warnfunnel")
+    monkeypatch.setenv("RAFT_TPU_LEDGER", str(tmp_path))
+    run = obs_ledger.start_run("test")
+    with pytest.warns(UserWarning, match="tri-channel"):
+        obs_log.warn(logger, "tri-channel message", UserWarning)
+    run.finish(ok=True)
+    events = obs_ledger.read_events(run.path)
+    warning = [e for e in events if e["event"] == "warning"]
+    assert warning and warning[0]["message"] == "tri-channel message"
+
+
+def test_display_funnel_prints(capsys):
+    logger = obs_log.get_logger("test.display")
+    obs_log.display(logger, "progress line")
+    assert "progress line" in capsys.readouterr().out

@@ -55,6 +55,67 @@ def test_phase_stack_is_thread_local():
     profiling.reset()
 
 
+def test_stats_and_summary_per_call_statistics():
+    """stats() carries per-call min/mean/max; summary() renders them
+    with a %-of-total column; report() keeps its {phase: seconds}
+    contract untouched."""
+    profiling.reset()
+    with profiling.phase("outer"):
+        for _ in range(3):
+            with profiling.phase("inner"):
+                pass
+    st = profiling.stats()
+    assert st["outer/inner"]["calls"] == 3
+    v = st["outer/inner"]
+    assert v["min"] <= v["mean"] <= v["max"]
+    assert abs(v["mean"] - v["total"] / 3) < 1e-12
+    # report() stays the stable flat {phase: seconds} mapping
+    rep = profiling.report()
+    assert set(rep) == {"outer", "outer/inner"}
+    assert all(isinstance(x, float) for x in rep.values())
+
+    text = profiling.summary()
+    header, *rows = text.splitlines()
+    for col in ("calls", "total_s", "min_s", "mean_s", "max_s", "%"):
+        assert col in header
+    assert any("outer/inner" in r for r in rows)
+    # %-of-total is computed against top-level phases: 'outer' is 100%
+    outer_row = next(r for r in rows
+                     if r.startswith("outer ") or r.startswith("outer  "))
+    assert "100.0%" in outer_row
+    profiling.reset()
+    assert profiling.summary() == "(no phases recorded)"
+
+
+def test_listeners_observe_phase_exits_and_survive_errors():
+    profiling.reset()
+    seen = []
+
+    def good(name, seconds):
+        seen.append((name, seconds))
+
+    def bad(name, seconds):
+        raise RuntimeError("observer crash")
+
+    profiling.add_listener(bad)
+    profiling.add_listener(good)
+    try:
+        with profiling.phase("watched"):
+            pass
+    finally:
+        profiling.remove_listener(bad)
+        profiling.remove_listener(good)
+    # the crashing listener neither killed the timed code nor starved
+    # the healthy one
+    assert [n for n, _ in seen] == ["watched"]
+    assert seen[0][1] >= 0.0
+    # removed listeners stop observing
+    with profiling.phase("unwatched"):
+        pass
+    assert len(seen) == 1
+    profiling.reset()
+
+
 def test_concurrent_phases_do_not_corrupt_counts():
     profiling.reset()
     n_threads, n_iter = 4, 50
