@@ -98,6 +98,54 @@ def executor_config(overrides=None) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# background compile pipeline / serialized-executable cache
+# (raft_tpu.parallel.compile_service)
+# ---------------------------------------------------------------------------
+
+# Defaults for the AOT compile pipeline (see docs/performance.md,
+# "Killing the cold start").  `service` compiles the sweep chunk executables on
+# background worker threads (XLA compiles release the GIL) so host-side
+# sweep setup — variant stacking, aero-servo tables, resident upload —
+# overlaps the compile; OFF compiles inline at submit (results are
+# identical, the cold start just serializes again).  `workers` bounds
+# concurrent XLA compiles.  `exec_cache` points at a directory of
+# SERIALIZED executables (jax.experimental.serialize_executable): a
+# fresh process deserializes the chunk executables from it instead of
+# recompiling — the warm-start path serving workers and CI pre-bake via
+# :func:`raft_tpu.sweep.precompile`.  None disables the cache.
+# Environment overrides: RAFT_TPU_COMPILE_SERVICE=0,
+# RAFT_TPU_COMPILE_WORKERS=<n>, RAFT_TPU_EXEC_CACHE=<dir>.
+COMPILE_DEFAULTS = {
+    "service": True,
+    "workers": 2,
+    "exec_cache": None,
+}
+
+
+def compile_config(overrides=None) -> dict:
+    """Effective compile-pipeline configuration: defaults, then
+    environment, then explicit ``overrides``."""
+    import os
+
+    cfg = dict(COMPILE_DEFAULTS)
+    env = os.environ.get("RAFT_TPU_COMPILE_SERVICE")
+    if env is not None:
+        cfg["service"] = env not in ("0", "false", "")
+    env = os.environ.get("RAFT_TPU_COMPILE_WORKERS")
+    if env is not None:
+        cfg["workers"] = max(1, int(env))
+    env = os.environ.get("RAFT_TPU_EXEC_CACHE")
+    if env is not None:
+        cfg["exec_cache"] = env or None
+    if overrides:
+        unknown = set(overrides) - set(cfg)
+        if unknown:
+            raise ValueError(f"unknown compile config key(s): {sorted(unknown)}")
+        cfg.update(overrides)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
 # run-ledger telemetry / trace capture (raft_tpu.obs)
 # ---------------------------------------------------------------------------
 
@@ -181,8 +229,19 @@ def enable_compilation_cache(path: str | None = None) -> str | None:
     warning of SIGILL and falls back to recompiling anyway, even on the
     machine that wrote them.  On the CPU backend the cache is therefore
     all cost and no benefit; this is a no-op there (returns None).
+    Composes with the serialized-executable cache: when
+    ``RAFT_TPU_EXEC_CACHE`` is also set but its directory was populated
+    by a DIFFERENT backend, every exec-cache lookup silently misses (the
+    backend is part of each entry's fingerprint) and this XLA cache
+    quietly papers over the cost — warn once so the misconfiguration is
+    visible instead of just slow.
     """
     import os
+
+    # lazy import: parallel.compile_service imports this module
+    from .parallel.compile_service import warn_if_backend_mismatch
+
+    warn_if_backend_mismatch()
 
     if jax.default_backend() == "cpu":
         if path is not None:

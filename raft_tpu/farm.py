@@ -25,6 +25,10 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from .obs import log as obs_log
+
+_LOG = obs_log.get_logger("farm")
+
 
 def power_thrust_curve(model, uhubs, nfowt=0, nrotor=0, heading=0.0):
     """P(U), CT(U), CP(U) and platform pitch over hub wind speeds
@@ -126,7 +130,7 @@ def find_equilibrium(model, case, wake_farm, max_iter=20, tol=0.1, display=0):
             break
         U_eff = U_new
         if display:
-            print(f"wake iter {it}: U_eff = {np.round(U_eff, 2)}")
+            obs_log.display(_LOG, f"wake iter {it}: U_eff = {np.round(U_eff, 2)}")
     return X, U_eff
 
 

@@ -30,10 +30,13 @@ import numpy as np
 import jax
 import jax.numpy as jnp
 
+from ..obs import log as obs_log
 from ..ops import waves as waves_ops
 from ..ops import waves2
 from ..ops import transforms
 from ..structure import member as mstruct
+
+_LOG = obs_log.get_logger("hydro.second_order")
 
 
 # ---------------------------------------------------------------------------
@@ -511,11 +514,17 @@ def calc_hydro_force_2nd_ord(fowt, beta, S0, iCase=None, iWT=None, interpMode="q
     else:
         # vectorized linear blend of the two bracketing heading slices
         if beta < heads[0]:
-            print(f"Warning in calcHydroForce_2ndOrd: angle {beta} is less than the minimum "
-                  f"incidence angle in the QTF. An incidence of {heads[0]} will be considered.")
+            obs_log.warn(
+                _LOG,
+                f"calcHydroForce_2ndOrd: angle {beta} is less than the "
+                "minimum incidence angle in the QTF. An incidence of "
+                f"{heads[0]} will be considered.")
         if beta > heads[-1]:
-            print(f"Warning in calcHydroForce_2ndOrd: angle {beta} is more than the maximum "
-                  f"incidence angle in the QTF. An incidence of {heads[-1]} will be considered.")
+            obs_log.warn(
+                _LOG,
+                f"calcHydroForce_2ndOrd: angle {beta} is more than the "
+                "maximum incidence angle in the QTF. An incidence of "
+                f"{heads[-1]} will be considered.")
         b = np.clip(beta, heads[0], heads[-1])
         i1 = int(np.clip(np.searchsorted(heads, b, side="right") - 1, 0, len(heads) - 2))
         t = (b - heads[i1]) / (heads[i1 + 1] - heads[i1])

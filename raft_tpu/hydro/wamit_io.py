@@ -16,6 +16,10 @@ from __future__ import annotations
 
 import numpy as np
 
+from ..obs import log as obs_log
+
+_LOG = obs_log.get_logger("hydro.wamit_io")
+
 
 def read_wamit1(path, TFlag=True):
     """Read a WAMIT .1 file.
@@ -108,8 +112,10 @@ def read_hydro(fowt):
         # OC4semi-WAMIT_Coefs example ships only the .1/.12d pair):
         # radiation coefficients still load; excitation stays zero and
         # strip theory provides the first-order forcing
-        print(f"Warning: {fowt.hydroPath}.3 not found; BEM excitation set to zero "
-              "(using strip-theory excitation only).")
+        obs_log.warn(
+            _LOG,
+            f"{fowt.hydroPath}.3 not found; BEM excitation set to zero "
+            "(using strip-theory excitation only).")
         heads = np.array([0.0])
         w3 = np.array([w1[-1] if len(w1) > 2 else 1.0])
         R = np.zeros([1, 6, 1])
@@ -126,8 +132,11 @@ def read_hydro(fowt):
     if not np.any(A0):
         ilow = 2 + int(np.argmin(w1[2:]))
         A0 = addedMass[:, :, ilow:ilow + 1]
-        print(f"Note: {fowt.hydroPath}.1 has no zero-frequency entries; "
-              "anchoring low-frequency added mass at the lowest available frequency.")
+        obs_log.display(
+            _LOG,
+            f"Note: {fowt.hydroPath}.1 has no zero-frequency entries; "
+            "anchoring low-frequency added mass at the lowest available "
+            "frequency.")
     addedMassInterp = _interp_axis2(np.hstack([w1[2:], 0.0]),
                                     np.dstack([addedMass[:, :, 2:], A0]),
                                     fowt.w)

@@ -11,8 +11,11 @@ from __future__ import annotations
 
 import numpy as np
 
+from .obs import log as obs_log
 from .schema import get_from_dict
 from .ops import waves as waves_ops
+
+_LOG = obs_log.get_logger("io_utils")
 
 
 def get_unique_case_headings(keys, values):
@@ -139,7 +142,10 @@ def convert_iea_turbine_yaml(fname_turbine, n_span=30):
                "key": ["alpha", "c_l", "c_d", "c_m"], "data": []}
         pol = af["polars"][0]
         if len(af["polars"]) > 1:
-            print(f"Warning for airfoil {af['name']}, only one polar entry is used (the first).")
+            obs_log.warn(
+                _LOG,
+                f"Warning for airfoil {af['name']}, only one polar entry "
+                "is used (the first).")
         for j in range(len(pol["c_l"]["grid"])):
             if (pol["c_l"]["grid"][j] == pol["c_d"]["grid"][j]
                     and pol["c_l"]["grid"][j] == pol["c_m"]["grid"][j]):

@@ -21,11 +21,12 @@ helpers that preserve the established user-facing contracts:
 from __future__ import annotations
 
 import logging
+import threading
 import warnings
 
 from . import ledger
 
-__all__ = ["get_logger", "warn", "display"]
+__all__ = ["get_logger", "warn", "warn_once", "display"]
 
 _PACKAGE = "raft_tpu"
 
@@ -63,6 +64,31 @@ def warn(logger: logging.Logger, message: str,
     logger.warning(message)
     ledger.emit("warning", message=str(message))
     warnings.warn(message, category, stacklevel=stacklevel + 1)
+
+
+_ONCE_KEYS: set = set()
+_ONCE_LOCK = threading.Lock()
+
+
+def warn_once(logger: logging.Logger, key, message: str) -> bool:
+    """Per-process once-only warning: logs at WARNING and mirrors into
+    the ledger, at most once per hashable ``key``.
+
+    Unlike :func:`warn` this deliberately does NOT go through
+    ``warnings.warn`` — it exists for configuration diagnostics raised
+    from hot or repeated paths (e.g. an exec cache pinned to a different
+    backend, checked at every compile-service construction) where the
+    warnings machinery would either spam or be silently deduplicated
+    without the ledger/logger mirror.  Returns True when the message was
+    actually emitted, False when ``key`` had already fired.
+    """
+    with _ONCE_LOCK:
+        if key in _ONCE_KEYS:
+            return False
+        _ONCE_KEYS.add(key)
+    logger.warning(message)
+    ledger.emit("warning", message=str(message))
+    return True
 
 
 def display(logger: logging.Logger, message: str) -> None:

@@ -113,9 +113,13 @@ def render(events):
     # ---- compile vs execute ---------------------------------------------
     compiles = by.get("compile_end", [])
     cache_hits = by.get("compile_cache", [])
+    exec_cache_evs = [ev for name in ("exec_cache_hit", "exec_cache_miss",
+                                      "exec_cache_store", "exec_cache_reject")
+                      for ev in by.get(name, [])]
+    overlaps = by.get("compile_overlap", [])
     exec_s = sum(st.get("total", 0.0) for name, st in stats.items()
                  if name.endswith("chunks/compute"))
-    if compiles or cache_hits or exec_s:
+    if compiles or cache_hits or exec_cache_evs or exec_s:
         lines += _section("compile vs execute")
         compile_s = 0.0
         for ev in compiles:
@@ -128,6 +132,26 @@ def render(events):
         for ev in cache_hits:
             lines.append("executables: reused from in-process template memo "
                          "(cache hit, 0 compiles)")
+        for ev in by.get("exec_cache_hit", []):
+            lines.append(f"exec cache: {ev.get('key')} deserialized "
+                         f"({(ev.get('seconds') or 0.0):.3f} s, no compile)")
+        for ev in by.get("exec_cache_store", []):
+            lines.append(f"exec cache: {ev.get('key')} serialized "
+                         f"({_fmt_bytes(ev.get('bytes'))})")
+        for ev in by.get("exec_cache_reject", []):
+            lines.append(f"exec cache: {ev.get('key')} REJECTED -> fresh "
+                         f"compile ({ev.get('reason')})")
+        # the overlap-efficiency line: how much of the compile the plan
+        # phase's host work hid, and what the first dispatch still paid
+        for ev in overlaps[-1:]:
+            c_s = ev.get("compile_s") or 0.0
+            hidden = ev.get("hidden_s") or 0.0
+            pct = 100.0 * hidden / c_s if c_s > 0 else 0.0
+            lines.append(
+                f"overlap: {ev.get('host_s', 0.0):.3f} s of host work ran "
+                f"during {c_s:.3f} s of background compile "
+                f"({pct:.0f}% of compile hidden); first-dispatch stall "
+                f"{ev.get('stall_s', 0.0):.3f} s")
         lines.append(f"compile {compile_s:.3f} s vs chunk execute "
                      f"{exec_s:.3f} s"
                      + (f"  ({compile_s / (compile_s + exec_s) * 100.0:.0f}% "

@@ -16,8 +16,12 @@ Lifecycle of one ``sweep()`` run (see docs/observability.md for the
 full narrative)::
 
     run_start -> template_build -> stack_build -> plan
-              -> compile_start/compile_end (per executable) | compile_cache
+              -> compile_submitted (per executable) | compile_cache
+                 ... host setup overlaps the background compiles ...
+              -> exec_cache_{hit,miss,reject} | compile_start (real compile)
+                 [+ exec_cache_store on a fresh compile with the cache armed]
               -> transfer (resident upload) -> device_memory
+              -> compile_overlap + compile_end (first-dispatch join)
               -> { chunk_dispatch -> chunk_fetch -> chunk_commit }*
                  with chunk_fault / quarantine_* / status_transition
                  and checkpoint_flush interleaved
@@ -40,9 +44,26 @@ EVENTS: dict[str, tuple] = {
     # -- build / compile --------------------------------------------------
     "template_build": ("cache",),               # 'hit' | 'build'; + seconds
     "stack_build": ("cache",),                  # 'hit' | 'build'; + seconds
-    "compile_start": ("key",),                  # executable key ('A' | 'B')
-    "compile_end": ("key", "cache"),            # + seconds, xla_compiles
+    "compile_submitted": ("key",),              # task handed to the compile
+                                                #   service; + background
+    "compile_start": ("key",),                  # + real (True = an actual
+                                                #   XLA compile is starting,
+                                                #   not an exec-cache load)
+    "compile_end": ("key", "cache"),            # cache: 'hit' | 'miss' |
+                                                #   'exec_cache';
+                                                #   + seconds, xla_compiles,
+                                                #   source
     "compile_cache": ("cache",),                # memoized executables reused
+    "compile_overlap": ("compile_s", "host_s", "stall_s"),
+                                                # first-dispatch join
+                                                #   accounting; + hidden_s,
+                                                #   sources
+    # -- serialized-executable cache (RAFT_TPU_EXEC_CACHE) ----------------
+    "exec_cache_hit": ("key",),                 # + path, seconds
+    "exec_cache_miss": ("key",),                # + path
+    "exec_cache_store": ("key",),               # + path, bytes
+    "exec_cache_reject": ("key", "reason"),     # entry unusable -> fresh
+                                                #   compile fallback
     # -- data movement / device state ------------------------------------
     "transfer": ("direction", "bytes", "what"),  # 'h2d' | 'd2h'
     "device_memory": ("device",),               # + bytes_in_use, peak_bytes

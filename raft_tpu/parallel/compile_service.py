@@ -1,0 +1,341 @@
+"""Background AOT compile pipeline + serialized-executable cache.
+
+The sweep's split-program design (partA: packed design leaves -> props +
+params; partB: params -> metrics) lowers both chunk executables up
+front, which means the expensive part — ``lowered.compile()`` — is pure
+XLA work that releases the GIL.  This module exploits that twice:
+
+* :class:`CompileService` compiles submitted lowered programs on
+  background worker threads, so the sweep's host-side plan phase
+  (variant stacking, aero-servo tables, resident upload, checkpoint
+  setup) runs CONCURRENTLY with XLA.  The caller holds
+  :class:`CompileTask` futures and joins them at first chunk dispatch
+  (``executor.wait_for_executables``), making the first-dispatch stall —
+  not the whole compile — the cold-start cost.
+* A serialized-executable cache (``RAFT_TPU_EXEC_CACHE``, via
+  ``jax.experimental.serialize_executable``): a fresh compile is
+  serialized to disk keyed by (backend, platform, executable key,
+  ``jit_key`` tag, StableHLO program hash), and a later process
+  deserializes it instead of recompiling — zero real XLA compiles on a
+  warm cache.  Any mismatch (jax/jaxlib version, backend, corrupt or
+  truncated entry) is REJECTED with an ``exec_cache_reject`` ledger
+  event and falls back to a fresh compile; the cache can slow nothing
+  down, only skip work.
+
+Every step is ledger-visible: ``compile_submitted`` at submit,
+``exec_cache_{hit,miss,store,reject}`` on the cache path,
+``compile_start(real=True)`` only when an actual XLA compile begins.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import pickle
+import threading
+import time
+
+import jax
+
+from .. import profiling
+from ..config import compile_config
+from ..obs import ledger as obs_ledger
+from ..obs import log as obs_log
+
+__all__ = [
+    "CompileService",
+    "CompileTask",
+    "program_hash",
+    "exec_cache_backend_pin",
+    "warn_if_backend_mismatch",
+]
+
+_LOG = obs_log.get_logger("parallel.compile_service")
+
+# Test seam: when set, called as ``hook(key)`` on the worker thread
+# immediately before a REAL XLA compile (never on the exec-cache hit
+# path) — the overlap tests inject a slow compile here.
+_COMPILE_HOOK = None
+
+# Bump when the on-disk entry layout changes; older entries are rejected.
+_ENTRY_VERSION = 1
+
+# Marker file recording which backend first populated a cache directory;
+# lets a process on a DIFFERENT backend warn instead of silently missing
+# every (backend-fingerprinted) lookup.
+_PIN_FILE = "BACKEND"
+
+
+def program_hash(lowered) -> str:
+    """Content hash of a lowered program's StableHLO text.
+
+    Part of the cache key: two programs that lower identically may share
+    a serialized executable; any change to shapes, donation, shardings,
+    or the math shows up here and misses the cache.
+    """
+    return hashlib.sha256(lowered.as_text().encode()).hexdigest()
+
+
+def _backend_fingerprint():
+    """(backend platform, device kind) the executable is pinned to."""
+    dev = jax.devices()[0]
+    return jax.default_backend(), str(getattr(dev, "device_kind", "unknown"))
+
+
+def _entry_meta(key, tag, phash) -> dict:
+    import jaxlib
+
+    backend, kind = _backend_fingerprint()
+    return {
+        "entry_version": _ENTRY_VERSION,
+        "jax": jax.__version__,
+        "jaxlib": getattr(jaxlib, "__version__", "unknown"),
+        "backend": backend,
+        "platform": kind,
+        "key": str(key),
+        "tag": str(tag),
+        "program": phash,
+    }
+
+
+def _entry_path(cache_dir, key, tag, phash) -> str:
+    h = hashlib.sha256()
+    for part in (*_backend_fingerprint(), str(key), str(tag), phash):
+        h.update(part.encode())
+        h.update(b"\0")
+    return os.path.join(cache_dir, f"{h.hexdigest()[:32]}.jexec")
+
+
+def _load_entry(path, key, run):
+    """Deserialize a cached executable, or None (miss / reject).
+
+    Version or backend drift and unreadable entries all land on the same
+    graceful path: emit the reason, return None, let the caller compile
+    fresh (and overwrite the bad entry via ``_store_entry``).
+    """
+    try:
+        with open(path, "rb") as fh:
+            entry = pickle.load(fh)
+    except FileNotFoundError:
+        run.emit("exec_cache_miss", key=str(key), path=path)
+        return None
+    except Exception as exc:  # truncated pickle, permission, garbage ...
+        reason = f"unreadable entry ({type(exc).__name__}: {exc})"
+        run.emit("exec_cache_reject", key=str(key), reason=reason, path=path)
+        _LOG.warning("exec cache: %s -> recompiling %s", reason, key)
+        return None
+    try:
+        meta = entry["meta"]
+        expect = _entry_meta(key, meta.get("tag", ""), meta.get("program", ""))
+        for field in ("entry_version", "jax", "jaxlib", "backend", "platform"):
+            if meta.get(field) != expect[field]:
+                reason = (f"{field} mismatch (entry {meta.get(field)!r}, "
+                          f"running {expect[field]!r})")
+                run.emit("exec_cache_reject", key=str(key), reason=reason,
+                         path=path)
+                _LOG.warning("exec cache: %s -> recompiling %s", reason, key)
+                return None
+        from jax.experimental.serialize_executable import deserialize_and_load
+
+        t0 = time.perf_counter()
+        compiled = deserialize_and_load(
+            entry["payload"], entry["in_tree"], entry["out_tree"])
+        run.emit("exec_cache_hit", key=str(key), path=path,
+                 seconds=round(time.perf_counter() - t0, 6))
+        return compiled
+    except Exception as exc:
+        reason = f"deserialize failed ({type(exc).__name__}: {exc})"
+        run.emit("exec_cache_reject", key=str(key), reason=reason, path=path)
+        _LOG.warning("exec cache: %s -> recompiling %s", reason, key)
+        return None
+
+
+def _store_entry(path, key, tag, phash, compiled, run) -> None:
+    """Serialize a freshly compiled executable into the cache.
+
+    Best-effort by design: some executables do not serialize (e.g. mesh
+    shardings on certain backends), and a full disk must not kill the
+    sweep that just paid for the compile — failures log and return.
+    """
+    try:
+        from jax.experimental.serialize_executable import serialize
+
+        payload, in_tree, out_tree = serialize(compiled)
+        entry = {
+            "meta": _entry_meta(key, tag, phash),
+            "payload": payload,
+            "in_tree": in_tree,
+            "out_tree": out_tree,
+        }
+        cache_dir = os.path.dirname(path) or "."
+        os.makedirs(cache_dir, exist_ok=True)
+        tmp = f"{path}.{os.getpid()}.tmp"
+        with open(tmp, "wb") as fh:
+            pickle.dump(entry, fh)
+        os.replace(tmp, path)  # atomic: readers never see partial entries
+        pin = os.path.join(cache_dir, _PIN_FILE)
+        if not os.path.exists(pin):
+            with open(pin, "w") as fh:
+                fh.write(jax.default_backend() + "\n")
+        run.emit("exec_cache_store", key=str(key), path=path,
+                 bytes=len(payload))
+    except Exception as exc:
+        _LOG.warning("exec cache: store failed for %s (%s: %s)",
+                     key, type(exc).__name__, exc)
+
+
+def exec_cache_backend_pin(cache_dir):
+    """Backend recorded in ``cache_dir``'s pin marker, or None."""
+    try:
+        with open(os.path.join(cache_dir, _PIN_FILE)) as fh:
+            return fh.read().strip() or None
+    except OSError:
+        return None
+
+
+def warn_if_backend_mismatch(cache_dir=None):
+    """Warn ONCE when the exec cache is pinned to a different backend.
+
+    The backend is part of every entry's path fingerprint, so a cache
+    populated on TPU looks simply EMPTY from a CPU process — each lookup
+    silently misses and recompiles.  This check turns that silence into
+    a single actionable warning (through the :mod:`raft_tpu.obs.log`
+    funnel, not ``warnings.warn``).  Called from compile-service
+    construction and from ``config.enable_compilation_cache`` so the two
+    caches compose visibly.  Returns ``(pinned, running)`` when they
+    differ, else None.
+    """
+    if cache_dir is None:
+        cache_dir = compile_config()["exec_cache"]
+    if not cache_dir:
+        return None
+    pinned = exec_cache_backend_pin(cache_dir)
+    running = jax.default_backend()
+    if pinned is None or pinned == running:
+        return None
+    obs_log.warn_once(
+        _LOG, ("exec-cache-backend", os.path.abspath(cache_dir), pinned, running),
+        f"RAFT_TPU_EXEC_CACHE={cache_dir!r} is pinned to backend {pinned!r} "
+        f"but this process runs on {running!r}: every executable lookup "
+        "will miss and recompile. Point each backend at its own cache "
+        "directory to re-enable warm starts.")
+    return (pinned, running)
+
+
+class CompileTask:
+    """One executable build in flight on the compile service.
+
+    ``result`` is the ``jax.stages.Compiled`` (or the exception the
+    build raised — the caller decides whether that is fatal), ``source``
+    records where it came from (``'compile'`` | ``'exec_cache'`` |
+    ``'error'``), ``seconds`` the pure compile/deserialize cost, and
+    ``submitted_at``/``done_at`` (``time.perf_counter()``) bracket the
+    task's full background lifetime for overlap accounting.
+    """
+
+    def __init__(self, key):
+        self.key = key
+        self.source = None
+        self.result = None
+        self.seconds = None
+        self.warm_error = None
+        self.submitted_at = time.perf_counter()
+        self.done_at = None
+        self._done = threading.Event()
+
+    @property
+    def pending(self) -> bool:
+        return not self._done.is_set()
+
+    def wait(self):
+        """Block until the build finishes; returns the result (which may
+        be an exception instance — not raised here)."""
+        self._done.wait()
+        return self.result
+
+
+class CompileService:
+    """Compile lowered programs concurrently on daemon worker threads.
+
+    XLA compiles release the GIL, so up to ``workers`` builds genuinely
+    overlap each other and the submitting thread's host work.  With the
+    service disabled (``RAFT_TPU_COMPILE_SERVICE=0``) ``submit`` runs
+    the build inline before returning — results are identical, the join
+    just never stalls; kept as a bisection aid.
+    """
+
+    def __init__(self, run=None, config=None):
+        cfg = compile_config(config)
+        self._run = run if run is not None else obs_ledger.NULL_RUN
+        self._background = bool(cfg["service"])
+        self._cache_dir = cfg["exec_cache"]
+        self._sem = threading.BoundedSemaphore(max(1, int(cfg["workers"])))
+        if self._cache_dir:
+            warn_if_backend_mismatch(self._cache_dir)
+
+    @property
+    def cache_dir(self):
+        return self._cache_dir
+
+    def submit(self, key, lowered, *, cache_tag=None, warm_args_fn=None):
+        """Queue ``lowered.compile()`` (or an exec-cache load) for
+        ``key``; returns a :class:`CompileTask` immediately.
+
+        ``cache_tag`` scopes the serialized-executable lookup (the sweep
+        passes the ``jit_key`` repr); None opts this task out of the
+        cache.  ``warm_args_fn``, when given, is called after the build
+        and its result is run through the executable once (discarded) —
+        the warm-up that pre-triggers any lazy backend initialization;
+        failures land in ``task.warm_error`` instead of raising.
+        """
+        task = CompileTask(key)
+        self._run.emit("compile_submitted", key=str(key),
+                       background=self._background,
+                       exec_cache=bool(self._cache_dir and cache_tag is not None))
+        if self._background:
+            worker = threading.Thread(
+                target=self._work, args=(task, lowered, cache_tag, warm_args_fn),
+                name=f"raft-compile-{key}", daemon=True)
+            worker.start()
+        else:
+            self._work(task, lowered, cache_tag, warm_args_fn)
+        return task
+
+    def _work(self, task, lowered, cache_tag, warm_args_fn):
+        run = self._run
+        try:
+            with self._sem, profiling.phase(f"compile/{task.key}"):
+                compiled = None
+                entry_path = phash = None
+                if self._cache_dir and cache_tag is not None:
+                    phash = program_hash(lowered)
+                    entry_path = _entry_path(
+                        self._cache_dir, task.key, cache_tag, phash)
+                    t0 = time.perf_counter()
+                    compiled = _load_entry(entry_path, task.key, run)
+                    if compiled is not None:
+                        task.source = "exec_cache"
+                        task.seconds = time.perf_counter() - t0
+                if compiled is None:
+                    if _COMPILE_HOOK is not None:
+                        _COMPILE_HOOK(task.key)
+                    run.emit("compile_start", key=str(task.key), real=True)
+                    t0 = time.perf_counter()
+                    compiled = lowered.compile()
+                    task.seconds = time.perf_counter() - t0
+                    task.source = "compile"
+                    if entry_path is not None:
+                        _store_entry(entry_path, task.key, cache_tag, phash,
+                                     compiled, run)
+                task.result = compiled
+                if warm_args_fn is not None:
+                    try:
+                        jax.block_until_ready(compiled(*warm_args_fn()))
+                    except Exception as exc:
+                        task.warm_error = exc
+        except Exception as exc:
+            task.source = "error"
+            task.result = exc
+        finally:
+            task.done_at = time.perf_counter()
+            task._done.set()

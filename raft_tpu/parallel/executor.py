@@ -41,11 +41,56 @@ import time
 
 import jax
 
+from .. import profiling
+from ..obs import ledger as obs_ledger
 from ..obs import log as obs_log
 
-__all__ = ["gather_rows", "start_host_fetch", "CheckpointWriter"]
+__all__ = ["gather_rows", "start_host_fetch", "wait_for_executables",
+           "CheckpointWriter"]
 
 _LOG = obs_log.get_logger("parallel.executor")
+
+
+def wait_for_executables(tasks, run=None):
+    """First-dispatch join on the background compile pipeline.
+
+    ``tasks`` maps executable key -> :class:`CompileTask`
+    (:mod:`raft_tpu.parallel.compile_service`).  Blocks until every task
+    has a result and returns ``{key: result}`` — results may be
+    exception instances; the caller owns the fallback policy.
+
+    The stall is ledger-visible twice over: the wait runs inside a
+    ``wait_executable`` profiling phase (nested under whatever phase the
+    caller holds, e.g. ``sweep/chunks/wait_executable``), and a single
+    ``compile_overlap`` event accounts the whole window —
+
+    ``compile_s``  longest submit->done task lifetime (the critical
+                   compile path),
+    ``host_s``     host work that ran between first submit and this
+                   join (the overlap window the service bought),
+    ``stall_s``    how long this join actually blocked (the residual
+                   cold-start cost at first dispatch),
+    ``hidden_s``   compile time hidden behind host work
+                   (``min(compile_s - stall_s, host_s)``, floored at 0).
+    """
+    run = run if run is not None else obs_ledger.NULL_RUN
+    join_at = time.perf_counter()
+    with profiling.phase("wait_executable"):
+        for task in tasks.values():
+            task.wait()
+    stall = time.perf_counter() - join_at
+    if tasks and run.enabled:
+        first_submit = min(t.submitted_at for t in tasks.values())
+        compile_s = max(t.done_at - t.submitted_at for t in tasks.values())
+        host_s = max(join_at - first_submit, 0.0)
+        hidden = max(min(compile_s - stall, host_s), 0.0)
+        run.emit("compile_overlap",
+                 compile_s=round(compile_s, 6),
+                 host_s=round(host_s, 6),
+                 stall_s=round(stall, 6),
+                 hidden_s=round(hidden, 6),
+                 sources={str(k): t.source for k, t in tasks.items()})
+    return {k: t.result for k, t in tasks.items()}
 
 
 @jax.jit

@@ -18,8 +18,11 @@ from __future__ import annotations
 import numpy as np
 import jax.numpy as jnp
 
+from ..obs import log as obs_log
 from ..ops import transforms
 from ..schema import get_from_dict
+
+_LOG = obs_log.get_logger("rotor")
 
 rad2deg = 180.0 / np.pi
 rpm2radps = 2.0 * np.pi / 60.0
@@ -281,7 +284,10 @@ class Rotor:
                     raise ValueError(f"Cavitation occured at node {n} (first node = 0)")
                 cav_check[a, n] = sigma_crit + cpmin_node
         if np.any(cav_check < 0.0):
-            print("WARNING: Cavitation check was run and found a blade node that has cavitation occuring")
+            obs_log.warn(
+                _LOG,
+                "Cavitation check was run and found a blade node that has "
+                "cavitation occuring")
         return cav_check
 
     # ------------------------------------------------------------------

@@ -24,7 +24,6 @@ from __future__ import annotations
 
 import copy
 import itertools
-import time
 import warnings
 import zipfile
 
@@ -43,14 +42,15 @@ from .ops import waves
 from .parallel.design_batch import (SweepAxisError, pack_rows, pack_spec,
                                     set_in_design, stack_variants,
                                     unpack_leaves, variant_finite_mask)
+from .parallel.compile_service import CompileService
 from .parallel.executor import (CheckpointWriter, gather_rows,
-                                start_host_fetch)
+                                start_host_fetch, wait_for_executables)
 from .robust import (STATUS_NAN, STATUS_OK, STATUS_QUARANTINED, SolveHealth,
                      build_report, classify_health, format_report,
                      run_isolated)
 from .robust.health import STATUS_NAMES, reduce_design_status
 
-__all__ = ["sweep", "set_in_design", "case_aero_params"]
+__all__ = ["sweep", "precompile", "set_in_design", "case_aero_params"]
 
 _LOG = obs_log.get_logger("sweep")
 
@@ -63,9 +63,17 @@ _CHUNK_EXEC_HOOK = None
 
 # In-process template memo: repeat sweeps of the SAME base design (new
 # axis values / sea states / wind cases) reuse the template model, the
-# batched design compiler, and the compiled chunk executable instead of
-# re-jitting everything (~40 s of XLA compile per sweep() call on TPU).
-# Keyed by design content, so a mutated design never hits a stale entry.
+# batched design compiler, and the compiled chunk executables instead of
+# re-jitting everything.  This is the FIRST level of the compile story
+# (docs/performance.md): memo hit -> zero lowering/compile; memo miss ->
+# the serialized-executable cache (RAFT_TPU_EXEC_CACHE) deserializes a
+# prior process's executables; then the persistent XLA compile cache
+# (config.enable_compilation_cache) turns a fresh compile into a
+# deserialization; only a miss of all three pays real XLA compilation —
+# on background workers, overlapped with the host-side plan phase
+# (parallel/compile_service.py), ~27 s serialized at the BENCH_r05
+# volume otherwise.  Keyed by design content, so a mutated design never
+# hits a stale entry.
 _TEMPLATE_MEMO: dict = {}
 _TEMPLATE_MEMO_MAX = 4
 
@@ -318,11 +326,76 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         run.close()
 
 
+def precompile(base_design, axes, sea_states, n_iter=15, device=None,
+               display=0, chunk_size=256, wind=None, devices=None,
+               health=None):
+    """Warm up the sweep executables without dispatching any chunk.
+
+    Runs :func:`sweep`'s plan phase exactly — template model, variant
+    stacking, split-program lowering, background compile (through the
+    compile service and, when ``RAFT_TPU_EXEC_CACHE`` is set, the
+    serialized-executable cache) — then returns once the chunk
+    executables are built and memoized.  Afterwards:
+
+    * a ``sweep()`` in THIS process with the same design/axes shape
+      signature reuses the executables straight from the in-process
+      template memo (zero lowering, zero XLA), and
+    * with ``RAFT_TPU_EXEC_CACHE`` pointed at a shared directory, ANY
+      fresh process deserializes them instead of compiling — the
+      pre-bake hook for serving workers, autoscaled replicas, and CI.
+
+    Accepts the same arguments as :func:`sweep` (minus ``checkpoint`` —
+    nothing is executed, so there is no progress to persist).  The
+    factorial size of ``axes`` does not matter for the executables
+    beyond the chunk extent: precompiling with a small representative
+    grid warms sweeps over any same-shaped axes.
+
+    Returns a report dict: ``mode`` (``'fallback'`` means the axes fall
+    outside the batched path and there is nothing to AOT-precompile),
+    ``compiled`` mapping executable key to its build ``source``
+    (``'compile'`` | ``'exec_cache'``) and ``seconds``, and ``cache``
+    (``'memo'`` when the executables were already memoized in-process).
+    """
+    if devices is not None:
+        devices = list(devices)
+    run = obs_ledger.NULL_RUN
+    if obs_ledger.enabled():
+        n_designs = 1
+        for _, v in axes:
+            n_designs *= len(v)
+        run = obs_ledger.start_run(
+            "precompile",
+            fingerprint={"design": _design_hash(base_design)[:16],
+                         "axes": [str(p) for p, _ in axes],
+                         "n_designs": n_designs,
+                         "n_cases": len(sea_states)},
+            meta={"n_iter": int(n_iter), "chunk_size": int(chunk_size),
+                  "wind": wind is not None,
+                  "n_devices": len(devices) if devices is not None else 1})
+    try:
+        out = _sweep_impl(base_design, axes, sea_states, n_iter=n_iter,
+                          device=device, display=display, checkpoint=None,
+                          chunk_size=chunk_size, wind=wind, devices=devices,
+                          health=health, run=run, compile_only=True)
+        run.finish(ok=True)
+        return out
+    except BaseException as e:
+        run.finish(ok=False, error=f"{type(e).__name__}: {e}")
+        raise
+    finally:
+        run.close()
+
+
 def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
-                checkpoint, chunk_size, wind, devices, health, run):
+                checkpoint, chunk_size, wind, devices, health, run,
+                compile_only=False):
     """:func:`sweep` body; ``run`` is the active ledger run (NULL_RUN
     when telemetry is off — every ``run.emit`` is then a no-op and all
-    byte/stat collection is gated behind ``run.enabled``)."""
+    byte/stat collection is gated behind ``run.enabled``).
+
+    ``compile_only`` (:func:`precompile`) stops after the chunk
+    executables are built and memoized — no chunk is dispatched, no
+    results are produced; returns a small build report instead."""
     import os
 
     from .parallel.case_solve import make_parametric_solver
@@ -579,9 +652,8 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         zetas = put_c(zetas)
         betas = put_c(betas)
 
-        threads = []
+        pending_compile = None
         compile_sentinel = None
-        compile_times: dict = {}
         if jitted is None and run.enabled:
             # XLA cost accounting: count backend compiles while the AOT
             # build runs, so compile_end events can tell a real compile
@@ -601,14 +673,16 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             #      response metrics (the vmapped case solver).
             # Splitting exists for COLD-START latency, the number the
             # reference DOE workload actually pays (a fresh process per
-            # sweep, raft/parametersweep.py:56-100): the two compiles run
-            # concurrently on worker threads (XLA releases the GIL), and
-            # `.lower().compile()` builds executables without running
-            # anything, while the MAIN thread computes the aero-servo
-            # impedance tables in the same window.  Execution cost is
+            # sweep, raft/parametersweep.py:56-100): both programs are
+            # submitted to the background compile service
+            # (parallel/compile_service.py) the moment they are lowered —
+            # the compiles run concurrently on worker threads (XLA
+            # releases the GIL) or deserialize from the RAFT_TPU_EXEC_CACHE
+            # serialized-executable cache, while the MAIN thread keeps
+            # going (aero-servo tables, stack memo, resident upload,
+            # checkpoint setup).  The sweep blocks only at first chunk
+            # dispatch (`_join_compiles` below).  Execution cost is
             # unchanged — params is consumed on-device by B.
-            import threading
-
             solve_p = make_parametric_solver(
                 static, n_iter=n_iter, with_health=run_health,
                 tik_eps=hcfg["tik_eps"], tik_cond_tol=hcfg["tik_cond_tol"])
@@ -753,33 +827,16 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                     return j.lower(*args)
 
             lA = _lower(jA, *argsA)
-            built: dict = {}
-            warm_failures: dict = {}
 
-            # warm-exec only pays when the main thread has aero/variant
-            # table work to overlap it with; in 'plain' mode the join
-            # happens immediately, so a dummy run would simply extend the
-            # critical path by one chunk execution
+            # warm-exec (a discarded zero-argument run of the fresh
+            # executable, absorbing any lazy backend initialization on
+            # the worker thread) only pays when the main thread has
+            # aero/variant table work to overlap it with; in 'plain'
+            # mode the join happens almost immediately, so a dummy run
+            # would simply extend the critical path by one chunk
+            # execution.  Warm failures are best-effort: recorded on the
+            # task and surfaced after the join.
             warm_exec = mode != "plain"
-
-            def _compile(key, lowered, dummy_args_fn):
-                try:
-                    t0 = time.perf_counter()
-                    compiled = lowered.compile()
-                    compile_times[key] = time.perf_counter() - t0
-                    built[key] = compiled
-                    if warm_exec:
-                        # warm-exec is best-effort — the real chunk call
-                        # still works if the dummy run fails — but the
-                        # failure is recorded and surfaced after the join
-                        # (a broken warm run usually means every chunk
-                        # will pay the upload cost it was meant to hide)
-                        try:
-                            jax.block_until_ready(compiled(*dummy_args_fn()))
-                        except Exception as e:
-                            warm_failures[key] = e
-                except Exception as e:  # pragma: no cover - best-effort
-                    built[key] = e
 
             def _zeros_like_sds(tree, put):
                 return jax.tree_util.tree_map(
@@ -794,11 +851,16 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 def dummyA():
                     return (_zeros_like_sds(packed_sds, put_d),)
 
-            run.emit("compile_start", key="A")
-            tA = threading.Thread(target=_compile, args=("A", lA, dummyA),
-                                  daemon=True)
-            tA.start()
-            threads.append(tA)
+            # the serialized-executable cache entry is scoped by the full
+            # executable identity (jit_key covers mode/placement/extents/
+            # health trace) on top of the per-program StableHLO hash the
+            # service adds — a changed trace can never hit a stale entry
+            compile_service = CompileService(run=run)
+            pending_compile = {
+                "A": compile_service.submit(
+                    "A", lA, cache_tag=repr(jit_key),
+                    warm_args_fn=dummyA if warm_exec else None),
+            }
 
             # lowered.out_info leaves are OutInfo objects on recent JAX,
             # which .lower() rejects as abstract arguments — re-wrap as
@@ -834,18 +896,86 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                         put_d(np.zeros((chunk_size,), np.int32)))
 
             lB = _lower(jB, *argsB)
-            run.emit("compile_start", key="B")
-            tB = threading.Thread(target=_compile, args=("B", lB, dummyB),
-                                  daemon=True)
-            tB.start()
-            threads.append(tB)
+            pending_compile["B"] = compile_service.submit(
+                "B", lB, cache_tag=repr(jit_key),
+                warm_args_fn=dummyB if warm_exec else None)
+
+        # the template memo entry exists as soon as the programs are in
+        # flight (the compiled pair lands in it at the join); creating it
+        # here lets the stack/resident memos below attach to it on the
+        # SAME cold sweep instead of only after a warm repeat
+        entry = _TEMPLATE_MEMO.get(memo_key)
+        if (entry is None or entry["treedef"] != treedef
+                or entry.get("spec") != spec):
+            entry = {"model": model, "fowt": fowt, "compile_one": compile_one,
+                     "static": static, "treedef": treedef, "spec": spec,
+                     "jitted": {}}
+            _TEMPLATE_MEMO[memo_key] = entry
+        while len(_TEMPLATE_MEMO) > _TEMPLATE_MEMO_MAX:
+            _TEMPLATE_MEMO.pop(next(iter(_TEMPLATE_MEMO)))
+
+        def _join_compiles():
+            """First-dispatch join on the background compile pipeline:
+            returns the (cA, cB) chunk executables, blocking only for
+            whatever compile time the host work above failed to hide
+            (ledger: `compile_overlap`; profiling: `.../wait_executable`).
+            Idempotent — the memoized pair is returned on repeat calls."""
+            nonlocal jitted
+            if jitted is not None:
+                return jitted
+            built = wait_for_executables(pending_compile, run=run)
+            if compile_sentinel is not None:
+                compile_sentinel.__exit__(None, None, None)
+                for key, fname in (("A", "partA"), ("B", "partB")):
+                    # log-derived names wrap the function ("jit(partA)")
+                    n_xla = sum(
+                        v for k, v in
+                        compile_sentinel.compiles_by_name.items()
+                        if fname in k)
+                    task = pending_compile[key]
+                    run.emit("compile_end", key=key, seconds=task.seconds,
+                             cache=("exec_cache"
+                                    if task.source == "exec_cache"
+                                    else "miss" if n_xla else "hit"),
+                             xla_compiles=n_xla, source=task.source)
+            # surfaced unconditionally: a failed warm run usually means
+            # every chunk pays the upload cost it was meant to hide, and
+            # headless/CI runs (display=0) must see that too
+            for key in sorted(pending_compile):
+                err = pending_compile[key].warm_error
+                if err is None:
+                    continue
+                msg = (f"sweep: warm-exec of part {key} failed "
+                       f"({type(err).__name__}: {err}); first chunk "
+                       "will pay executable initialization")
+                obs_log.warn(_LOG, msg, RuntimeWarning)
+                if display:
+                    obs_log.display(_LOG, msg)
+            cA_, cB_ = built.get("A"), built.get("B")
+            if isinstance(cA_, Exception) or isinstance(cB_, Exception):
+                # AOT failed (e.g. an exotic sharding/backend combination):
+                # fall back to the plain jits, which compile inline at the
+                # first chunk call
+                if display:
+                    obs_log.display(
+                        _LOG,
+                        f"sweep: AOT compile failed ({cA_!r} / {cB_!r}); "
+                        "falling back to inline jit")
+                cA_, cB_ = jA, jB
+            jitted = (cA_, cB_)
+            entry = _TEMPLATE_MEMO.get(memo_key)
+            if entry is not None and entry.get("spec") == spec:
+                entry["jitted"][jit_key] = jitted
+            return jitted
 
         # main thread (overlapped with the compiles above): aero-servo
         # impedance for the shared-turbine case, or the per-turbine-variant
         # tables (model builds + rotor BEM) along turbine axes
         aero = None
         sel_variants = None
-        if mode == "aero":
+        if compile_only:
+            pass  # no chunk will run; the variant tables are execution-only
+        elif mode == "aero":
             with profiling.phase("sweep/aero"):
                 aero = put_c(case_aero_params(fowt, wind))
         elif aero_axes:
@@ -868,55 +998,27 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 sel_variants["B"] = np.stack(B_l)
             sel_variants = put_r(sel_variants)
 
-        if jitted is None:
-            with profiling.phase("sweep/compile_wait"):
-                for t in threads:
-                    t.join()
-            if compile_sentinel is not None:
-                compile_sentinel.__exit__(None, None, None)
-                for key, fname in (("A", "partA"), ("B", "partB")):
-                    # log-derived names wrap the function ("jit(partA)")
-                    n_xla = sum(
-                        v for k, v in
-                        compile_sentinel.compiles_by_name.items()
-                        if fname in k)
-                    run.emit("compile_end", key=key,
-                             seconds=compile_times.get(key),
-                             cache="miss" if n_xla else "hit",
-                             xla_compiles=n_xla)
-            cA, cB = built.get("A"), built.get("B")
-            # surfaced unconditionally: a failed warm run usually means
-            # every chunk pays the upload cost it was meant to hide, and
-            # headless/CI runs (display=0) must see that too
-            for key, err in sorted(warm_failures.items()):
-                msg = (f"sweep: warm-exec of part {key} failed "
-                       f"({type(err).__name__}: {err}); first chunk "
-                       "will pay executable initialization")
-                obs_log.warn(_LOG, msg, RuntimeWarning)
-                if display:
-                    obs_log.display(_LOG, msg)
-            if isinstance(cA, Exception) or isinstance(cB, Exception):
-                # AOT failed (e.g. an exotic sharding/backend combination):
-                # fall back to the plain jits, which compile inline at the
-                # first chunk call
-                if display:
-                    obs_log.display(
-                        _LOG,
-                        f"sweep: AOT compile failed ({cA!r} / {cB!r}); "
-                        "falling back to inline jit")
-                cA, cB = jA, jB
-            jitted = (cA, cB)
-            entry = _TEMPLATE_MEMO.get(memo_key)
-            if (entry is None or entry["treedef"] != treedef
-                    or entry.get("spec") != spec):
-                entry = {"model": model, "fowt": fowt, "compile_one": compile_one,
-                         "static": static, "treedef": treedef, "spec": spec,
-                         "jitted": {}}
-                _TEMPLATE_MEMO[memo_key] = entry
-            entry["jitted"][jit_key] = jitted
-            while len(_TEMPLATE_MEMO) > _TEMPLATE_MEMO_MAX:
-                _TEMPLATE_MEMO.pop(next(iter(_TEMPLATE_MEMO)))
-        cA, cB = jitted
+        if compile_only:
+            # precompile(): join, memoize (and, with RAFT_TPU_EXEC_CACHE,
+            # serialize) the executables, report — dispatch nothing
+            _join_compiles()
+            report = {"mode": mode, "chunk_size": chunk_size,
+                      "n_cases": n_cases, "n_designs": n_designs}
+            if pending_compile is None:
+                report["cache"] = "memo"
+                report["compiled"] = {}
+            else:
+                report["cache"] = None
+                report["compiled"] = {
+                    k: {"source": t.source,
+                        "seconds": (round(t.seconds, 6)
+                                    if t.seconds is not None else None)}
+                    for k, t in pending_compile.items()}
+            return report
+
+        # cA/cB are resolved by the first-dispatch join at the top of the
+        # chunk loop — everything in between runs while XLA compiles
+        cA = cB = None
         if cached_stack is None and stack_key is not None:
             entry = _TEMPLATE_MEMO.get(memo_key)
             if entry is not None and entry.get("treedef") == treedef:
@@ -986,6 +1088,13 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                                 health_resid.copy(), health_cond.copy()))
 
         with profiling.phase("sweep/chunks"), maybe_trace("chunks"):
+            # wait-for-executable: the background compiles (or exec-cache
+            # deserializations) submitted in the plan phase are joined
+            # HERE, at first chunk dispatch — the stall (if any) is the
+            # residual cold-start cost after the host overlap window, and
+            # lands in profiling as sweep/chunks/wait_executable with a
+            # matching `compile_overlap` ledger event
+            cA, cB = _join_compiles()
             # software-pipelined with bounded depth: chunk k+1's gather
             # and executables are queued before chunk k's results are
             # fetched, hiding the host->device->host round trips behind
@@ -1221,6 +1330,12 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
     # ----- fallback: per-variant model compile, batched device solve -----
     run.emit("plan", mode="fallback", n_chunks=-(-n_designs // chunk_size),
              chunk_size=chunk_size)
+    if compile_only:
+        # the per-variant fallback builds a fresh Model per design at
+        # execution time — there is no chunk executable to pre-bake
+        return {"mode": "fallback", "chunk_size": chunk_size,
+                "n_cases": n_cases, "n_designs": n_designs,
+                "cache": None, "compiled": {}}
     zetas, betas = _sea_state_waves(fowt, sea_states)
     aero = case_aero_params(fowt, wind) if wind is not None else None
     batched = None

@@ -1,0 +1,88 @@
+"""Cold-start attestation: run the demo sweep in THIS process against a
+serialized-executable cache and assert, from the run ledger, how the
+executables were obtained.
+
+CI runs this twice in SEPARATE processes sharing RAFT_TPU_EXEC_CACHE:
+
+    python scripts/coldstart_check.py --expect cold --ledger ledger-cold
+    python scripts/coldstart_check.py --expect warm --ledger ledger-warm
+
+The first process compiles for real and serializes the executables; the
+second must obtain every executable from the cache — only
+exec_cache_hit events, no compile_start with real=true — while
+producing finite results.  Process separation is the point: nothing
+in-memory (template memo, jax jit caches) can leak between the runs.
+"""
+
+import argparse
+import os
+import sys
+
+# invoked as `python scripts/coldstart_check.py` — put the repo root on
+# the path so raft_tpu imports regardless of the caller's cwd
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--expect", choices=["cold", "warm"], required=True)
+    ap.add_argument("--ledger", required=True,
+                    help="run-ledger directory for this process")
+    ap.add_argument("--cache", default=None,
+                    help="exec cache dir (default: $RAFT_TPU_EXEC_CACHE)")
+    args = ap.parse_args()
+
+    if args.cache:
+        os.environ["RAFT_TPU_EXEC_CACHE"] = args.cache
+    if not os.environ.get("RAFT_TPU_EXEC_CACHE"):
+        ap.error("--cache or RAFT_TPU_EXEC_CACHE is required")
+    os.environ["RAFT_TPU_LEDGER"] = args.ledger
+
+    import numpy as np
+
+    from raft_tpu.designs import demo_spar
+    from raft_tpu.obs import ledger as obs_ledger
+    from raft_tpu.sweep import sweep
+
+    axes = [("platform.members.0.d",
+             [[9.4, 9.4, 6.5, 6.5], [10.0, 10.0, 6.5, 6.5],
+              [10.5, 10.5, 6.5, 6.5], [11.0, 11.0, 6.5, 6.5]])]
+    out = sweep(demo_spar(nw_freqs=(0.05, 0.4)), axes,
+                [(4.0, 8.0), (6.0, 10.0)], n_iter=8, chunk_size=2)
+    assert np.all(np.isfinite(out["motion_std"])), "non-finite sweep output"
+
+    runs = obs_ledger.list_runs(args.ledger)
+    assert len(runs) == 1, f"expected one ledger run, found {runs}"
+    events = obs_ledger.read_events(runs[0])
+    by = {}
+    for ev in events:
+        by.setdefault(ev["event"], []).append(ev)
+
+    real_compiles = [e for e in by.get("compile_start", ())
+                     if e.get("real")]
+    hits = {e["key"] for e in by.get("exec_cache_hit", ())}
+    stores = {e["key"] for e in by.get("exec_cache_store", ())}
+    rejects = by.get("exec_cache_reject", ())
+
+    if args.expect == "cold":
+        assert real_compiles, "cold run performed no real XLA compiles"
+        assert stores == {"A", "B"}, (
+            f"cold run serialized {sorted(stores)}, expected A and B")
+    else:
+        assert not rejects, f"warm run rejected cache entries: {rejects}"
+        assert not real_compiles, (
+            "warm run performed REAL XLA compiles — the serialized "
+            f"executable cache did not carry across processes: {real_compiles}")
+        assert hits == {"A", "B"}, (
+            f"warm run deserialized {sorted(hits)}, expected A and B")
+        bad = [e for e in by.get("compile_end", ())
+               if e["cache"] != "exec_cache" or e.get("xla_compiles", 0)]
+        assert not bad, f"warm run compile_end not from exec cache: {bad}"
+
+    n = {k: len(v) for k, v in by.items() if k.startswith(("compile", "exec"))}
+    print(f"coldstart_check --expect {args.expect}: OK ({n})")
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

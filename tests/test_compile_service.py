@@ -1,0 +1,413 @@
+"""Background compile pipeline + serialized-executable cache
+(raft_tpu.parallel.compile_service, sweep.precompile).
+
+Three contracts under test:
+
+* the serialized-executable cache changes WHERE executables come from,
+  never what they compute: a warm exec-cache sweep performs ZERO real
+  XLA compiles (RecompileSentinel + ledger both attest) while staying
+  bit-identical to the freshly-compiled path, and every unusable entry
+  (corrupt, truncated, wrong jax version) is rejected with an
+  ``exec_cache_reject`` event and falls back to a clean fresh compile;
+* the compile service overlaps XLA with host work: with a fault-injected
+  slow compile, the host plan phases provably run while the compiles are
+  pending, and the ledger's ``compile_overlap`` accounting matches the
+  profiling phase stats at the first-dispatch join;
+* none of the knobs change results: compile service on/off and pipeline
+  depth 1 vs 3 are bit-identical.
+"""
+
+import os
+import pickle
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+
+from raft_tpu import profiling
+from raft_tpu import sweep as sweep_mod
+from raft_tpu.config import compile_config
+from raft_tpu.designs import demo_spar
+from raft_tpu.obs import ledger as obs_ledger
+from raft_tpu.parallel import compile_service as cs
+
+AXES = [("platform.members.0.d",
+         [[9.4, 9.4, 6.5, 6.5], [10.0, 10.0, 6.5, 6.5],
+          [10.5, 10.5, 6.5, 6.5], [11.0, 11.0, 6.5, 6.5]])]
+STATES = [(4.0, 8.0), (6.0, 10.0)]
+
+
+def _sweep(**kw):
+    kw.setdefault("n_iter", 8)
+    kw.setdefault("chunk_size", 2)
+    return sweep_mod.sweep(demo_spar(nw_freqs=(0.05, 0.4)), AXES, STATES, **kw)
+
+
+def _assert_same_results(a, b):
+    np.testing.assert_array_equal(a["motion_std"], b["motion_std"])
+    np.testing.assert_array_equal(a["AxRNA_std"], b["AxRNA_std"])
+    np.testing.assert_array_equal(a["status"], b["status"])
+    for k in ("mass", "displacement", "GMT"):
+        np.testing.assert_array_equal(a[k], b[k])
+
+
+def _ledger_sweep(tmp_path, monkeypatch, name, **kw):
+    ldir = tmp_path / name
+    monkeypatch.setenv("RAFT_TPU_LEDGER", str(ldir))
+    out = _sweep(**kw)
+    monkeypatch.delenv("RAFT_TPU_LEDGER")
+    runs = obs_ledger.list_runs(str(ldir))
+    assert len(runs) == 1, runs
+    return out, obs_ledger.read_events(runs[0])
+
+
+def _by(events):
+    by = {}
+    for ev in events:
+        by.setdefault(ev["event"], []).append(ev)
+    return by
+
+
+@pytest.fixture(scope="module")
+def exec_cache(tmp_path_factory):
+    """One serialized-executable cache directory shared by the sweep
+    tests in this module: the first cold sweep populates it, later
+    tests deserialize from it (cheap) instead of recompiling."""
+    return str(tmp_path_factory.mktemp("exec-cache"))
+
+
+@pytest.fixture(scope="module")
+def baseline():
+    """Reference sweep output, freshly compiled WITHOUT the exec cache
+    (the bit-identity anchor for every cached/knob variant)."""
+    old = os.environ.pop("RAFT_TPU_EXEC_CACHE", None)
+    try:
+        return _sweep()
+    finally:
+        if old is not None:
+            os.environ["RAFT_TPU_EXEC_CACHE"] = old
+
+
+# ---------------------------------------------------------------------------
+# config + unit-level cache behavior (tiny programs, no sweep)
+# ---------------------------------------------------------------------------
+
+
+def test_compile_config_defaults_and_env(monkeypatch):
+    for var in ("RAFT_TPU_COMPILE_SERVICE", "RAFT_TPU_COMPILE_WORKERS",
+                "RAFT_TPU_EXEC_CACHE"):
+        monkeypatch.delenv(var, raising=False)
+    assert compile_config() == {"service": True, "workers": 2,
+                                "exec_cache": None}
+    monkeypatch.setenv("RAFT_TPU_COMPILE_SERVICE", "0")
+    monkeypatch.setenv("RAFT_TPU_COMPILE_WORKERS", "7")
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE", "/tmp/x")
+    cfg = compile_config()
+    assert cfg == {"service": False, "workers": 7, "exec_cache": "/tmp/x"}
+    # workers floors at 1; empty cache path means disabled
+    monkeypatch.setenv("RAFT_TPU_COMPILE_WORKERS", "0")
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE", "")
+    cfg = compile_config()
+    assert cfg["workers"] == 1 and cfg["exec_cache"] is None
+    # explicit overrides beat the environment; unknown keys raise
+    assert compile_config({"service": True})["service"] is True
+    with pytest.raises(ValueError, match="unknown compile config"):
+        compile_config({"workres": 2})
+
+
+def _lowered_unit_fn():
+    def unit_fn(x):
+        return jnp.sin(x) * 2.0 + 1.0
+
+    return jax.jit(unit_fn).lower(
+        jax.ShapeDtypeStruct((8,), jnp.float32))
+
+
+def _unit_run(tmp_path, monkeypatch, name):
+    monkeypatch.setenv("RAFT_TPU_LEDGER", str(tmp_path / name))
+    return obs_ledger.start_run(name)
+
+
+def test_exec_cache_roundtrip_bit_identical(tmp_path, monkeypatch):
+    """serialize -> deserialize produces an executable whose output is
+    bit-identical to the freshly compiled one, with the full
+    miss/store/hit event story."""
+    cache = str(tmp_path / "cache")
+    cfg = {"exec_cache": cache, "service": False}
+    x = jnp.arange(8, dtype=jnp.float32)
+    run = _unit_run(tmp_path, monkeypatch, "roundtrip")
+
+    cold = cs.CompileService(run=run, config=cfg).submit(
+        "U", _lowered_unit_fn(), cache_tag="unit")
+    assert not cold.pending and cold.source == "compile"
+    want = np.asarray(cold.result(x))
+
+    warm = cs.CompileService(run=run, config=cfg).submit(
+        "U", _lowered_unit_fn(), cache_tag="unit")
+    assert warm.source == "exec_cache"
+    np.testing.assert_array_equal(np.asarray(warm.result(x)), want)
+
+    run.finish(ok=True)
+    by = _by(obs_ledger.read_events(run.path))
+    assert len(by["exec_cache_miss"]) == 1
+    assert len(by["exec_cache_store"]) == 1 and by["exec_cache_store"][0]["bytes"] > 0
+    assert len(by["exec_cache_hit"]) == 1
+    # only the cold build was a real compile
+    assert [e.get("real") for e in by["compile_start"]] == [True]
+    # a different cache tag is a different entry (no false sharing)
+    other = cs.CompileService(run=obs_ledger.NULL_RUN, config=cfg).submit(
+        "U", _lowered_unit_fn(), cache_tag="other-tag")
+    assert other.source == "compile"
+
+
+def test_corrupt_and_truncated_entries_fall_back(tmp_path, monkeypatch):
+    """Garbage or truncated cache files are rejected (with the reason)
+    and the build falls back to a clean fresh compile that REPAIRS the
+    entry."""
+    cache = str(tmp_path / "cache")
+    cfg = {"exec_cache": cache, "service": False}
+    x = jnp.arange(8, dtype=jnp.float32)
+    svc = cs.CompileService(run=obs_ledger.NULL_RUN, config=cfg)
+    want = np.asarray(svc.submit("U", _lowered_unit_fn(),
+                                 cache_tag="unit").result(x))
+    entry, = [os.path.join(cache, f) for f in os.listdir(cache)
+              if f.endswith(".jexec")]
+
+    for label, corruption in [
+            ("garbage", lambda raw: b"not a pickle at all"),
+            ("truncated", lambda raw: raw[: len(raw) // 2])]:
+        with open(entry, "rb") as fh:
+            raw = fh.read()
+        with open(entry, "wb") as fh:
+            fh.write(corruption(raw))
+        run = _unit_run(tmp_path, monkeypatch, f"corrupt-{label}")
+        task = cs.CompileService(run=run, config=cfg).submit(
+            "U", _lowered_unit_fn(), cache_tag="unit")
+        assert task.source == "compile", label
+        np.testing.assert_array_equal(np.asarray(task.result(x)), want)
+        run.finish(ok=True)
+        by = _by(obs_ledger.read_events(run.path))
+        rejects = by["exec_cache_reject"]
+        assert len(rejects) == 1 and rejects[0]["key"] == "U"
+        # the fresh compile re-stored a good entry
+        assert len(by["exec_cache_store"]) == 1
+        warm = cs.CompileService(run=obs_ledger.NULL_RUN, config=cfg).submit(
+            "U", _lowered_unit_fn(), cache_tag="unit")
+        assert warm.source == "exec_cache", label
+
+
+def test_jax_version_mismatch_rejected(tmp_path, monkeypatch):
+    """An entry written by a different jax version must NOT be loaded:
+    rejected with an exec_cache_reject naming the mismatch."""
+    cache = str(tmp_path / "cache")
+    cfg = {"exec_cache": cache, "service": False}
+    svc = cs.CompileService(run=obs_ledger.NULL_RUN, config=cfg)
+    svc.submit("U", _lowered_unit_fn(), cache_tag="unit")
+    entry, = [os.path.join(cache, f) for f in os.listdir(cache)
+              if f.endswith(".jexec")]
+    with open(entry, "rb") as fh:
+        payload = pickle.load(fh)
+    payload["meta"]["jax"] = "0.0.1-not-this-one"
+    with open(entry, "wb") as fh:
+        pickle.dump(payload, fh)
+
+    run = _unit_run(tmp_path, monkeypatch, "vermismatch")
+    task = cs.CompileService(run=run, config=cfg).submit(
+        "U", _lowered_unit_fn(), cache_tag="unit")
+    assert task.source == "compile"
+    run.finish(ok=True)
+    by = _by(obs_ledger.read_events(run.path))
+    reject, = by["exec_cache_reject"]
+    assert "jax mismatch" in reject["reason"]
+    assert "0.0.1-not-this-one" in reject["reason"]
+
+
+def test_backend_pin_mismatch_warns_once(tmp_path, monkeypatch, caplog):
+    """A cache populated by a different backend warns ONCE through the
+    obs/log funnel (NOT warnings.warn) instead of silently missing —
+    and enable_compilation_cache() runs the same check (the two caches
+    must compose visibly)."""
+    import logging
+    import warnings as warnings_mod
+
+    from raft_tpu.config import enable_compilation_cache
+
+    cache = str(tmp_path / "pinned")
+    os.makedirs(cache)
+    with open(os.path.join(cache, "BACKEND"), "w") as fh:
+        fh.write("definitely-not-this-backend\n")
+    assert cs.exec_cache_backend_pin(cache) == "definitely-not-this-backend"
+
+    with caplog.at_level(logging.WARNING, logger="raft_tpu.parallel.compile_service"):
+        with warnings_mod.catch_warnings():
+            warnings_mod.simplefilter("error")  # warnings.warn would raise
+            assert cs.warn_if_backend_mismatch(cache) == (
+                "definitely-not-this-backend", jax.default_backend())
+            # second call: still reports the mismatch, does not re-log
+            cs.warn_if_backend_mismatch(cache)
+            # the persistent-XLA-cache entry point runs the same check
+            monkeypatch.setenv("RAFT_TPU_EXEC_CACHE", cache)
+            enable_compilation_cache()
+    hits = [r for r in caplog.records if "pinned to backend" in r.getMessage()]
+    assert len(hits) == 1
+    # a matching pin stays silent
+    ok_cache = str(tmp_path / "ok")
+    os.makedirs(ok_cache)
+    with open(os.path.join(ok_cache, "BACKEND"), "w") as fh:
+        fh.write(jax.default_backend() + "\n")
+    assert cs.warn_if_backend_mismatch(ok_cache) is None
+
+
+# ---------------------------------------------------------------------------
+# sweep-level: zero-compile warm starts (the acceptance gate)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.sentinel
+def test_warm_exec_cache_sweep_zero_real_compiles(
+        tmp_path, monkeypatch, exec_cache, baseline):
+    """ISSUE acceptance: with RAFT_TPU_EXEC_CACHE warm, a cold-memo
+    sweep (fresh-process simulation) performs ZERO real XLA compiles —
+    RecompileSentinel and the ledger both attest — and its results are
+    bit-identical to the uncached freshly-compiled path."""
+    from raft_tpu.analysis.recompile import RecompileSentinel
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE", exec_cache)
+
+    # cold: populates the cache (real compiles, stores)
+    sweep_mod._TEMPLATE_MEMO.clear()
+    cold, cold_events = _ledger_sweep(tmp_path, monkeypatch, "cold")
+    cold_by = _by(cold_events)
+    assert {e["key"] for e in cold_by["exec_cache_store"]} == {"A", "B"}
+    assert [f for f in os.listdir(exec_cache) if f.endswith(".jexec")]
+    _assert_same_results(baseline, cold)
+
+    # warm: a fresh process would start exactly here — no template memo,
+    # only the on-disk executables
+    sweep_mod._TEMPLATE_MEMO.clear()
+    with RecompileSentinel() as s:
+        snap = s.snapshot()
+        warm, warm_events = _ledger_sweep(tmp_path, monkeypatch, "warm")
+        s.assert_no_recompile(snap, "warm exec-cache sweep")
+    assert s.backend_compiles == 0
+    _assert_same_results(baseline, warm)
+
+    by = _by(warm_events)
+    assert {e["key"] for e in by["exec_cache_hit"]} == {"A", "B"}
+    # no compile_start with real=true anywhere in the warm run
+    assert not [e for e in warm_events
+                if e["event"] == "compile_start" and e.get("real")]
+    for ev in by["compile_end"]:
+        assert ev["cache"] == "exec_cache" and ev["xla_compiles"] == 0
+    assert len(by["compile_overlap"]) == 1
+
+
+def test_precompile_warms_sweep(tmp_path, monkeypatch, exec_cache, baseline):
+    """sweep.precompile() builds + memoizes the executables without
+    dispatching anything; the following sweep() reuses them via the
+    template memo (compile_cache hit, zero compiles)."""
+    from raft_tpu.analysis.recompile import RecompileSentinel
+
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE", exec_cache)
+    sweep_mod._TEMPLATE_MEMO.clear()
+    report = sweep_mod.precompile(demo_spar(nw_freqs=(0.05, 0.4)), AXES,
+                                  STATES, n_iter=8, chunk_size=2)
+    assert report["mode"] == "plain"
+    assert set(report["compiled"]) == {"A", "B"}
+    for info in report["compiled"].values():
+        assert info["source"] in ("compile", "exec_cache")
+
+    with RecompileSentinel() as s:
+        snap = s.snapshot()
+        out, events = _ledger_sweep(tmp_path, monkeypatch, "after-pre")
+        s.assert_no_recompile(snap, "sweep after precompile")
+    _assert_same_results(baseline, out)
+    assert _by(events).get("compile_cache"), "expected a template-memo hit"
+
+    # repeat precompile: everything already memoized in-process
+    assert sweep_mod.precompile(demo_spar(nw_freqs=(0.05, 0.4)), AXES,
+                                STATES, n_iter=8,
+                                chunk_size=2)["cache"] == "memo"
+
+
+# ---------------------------------------------------------------------------
+# sweep-level: overlap accounting + knob bit-identity
+# ---------------------------------------------------------------------------
+
+
+def test_slow_compile_overlaps_host_work(tmp_path, monkeypatch):
+    """Fault-injected slow compile: the host plan phases (resident
+    upload et al.) provably run WHILE both compiles are pending, and the
+    ledger's compile_overlap accounting agrees with the profiling phase
+    stats at the join."""
+    monkeypatch.delenv("RAFT_TPU_EXEC_CACHE", raising=False)
+    sweep_mod._TEMPLATE_MEMO.clear()
+
+    uploaded = threading.Event()
+    hook_saw_upload = {}
+
+    def listener(name, seconds):
+        if name.endswith("sweep/resident_upload"):
+            uploaded.set()
+
+    def slow_compile_hook(key):
+        # blocks the worker until the MAIN thread has finished the
+        # resident upload — if host work did not overlap the compiles,
+        # this would deadlock the sweep until the 60 s timeout
+        hook_saw_upload[key] = uploaded.wait(timeout=60.0)
+
+    profiling.add_listener(listener)
+    monkeypatch.setattr(cs, "_COMPILE_HOOK", slow_compile_hook)
+    profiling.reset()
+    try:
+        out, events = _ledger_sweep(tmp_path, monkeypatch, "overlap")
+    finally:
+        profiling.remove_listener(listener)
+    assert hook_saw_upload == {"A": True, "B": True}
+    assert np.isfinite(out["motion_std"]).all()
+
+    by = _by(events)
+    ov, = by["compile_overlap"]
+    stats = profiling.stats()
+    # the join stall is the same interval the profiling phase timed
+    stall_phase = stats["sweep/chunks/wait_executable"]["total"]
+    assert abs(ov["stall_s"] - stall_phase) < 0.25, (ov, stall_phase)
+    # per-executable compile time landed in worker-thread phases
+    for key in ("A", "B"):
+        assert stats[f"compile/{key}"]["calls"] == 1
+    longest = max(stats[f"compile/{k}"]["total"] for k in ("A", "B"))
+    assert ov["compile_s"] >= longest - 0.25
+    # overlap identity: stall + hidden ~ compile critical path when host
+    # work is shorter than the compile (it is here — the hook blocks the
+    # workers until the host side finished)
+    assert ov["host_s"] > 0.0
+    assert ov["hidden_s"] <= ov["host_s"] + 1e-6
+    assert ov["stall_s"] <= ov["compile_s"] + 0.25
+    profiling.reset()
+
+
+def test_service_off_and_pipeline_depths_bit_identical(
+        monkeypatch, exec_cache, baseline):
+    """RAFT_TPU_COMPILE_SERVICE=0 (inline builds, no background
+    threads) and pipeline depth 1 vs 3 all reproduce the baseline
+    bit-for-bit, service on and off."""
+    monkeypatch.setenv("RAFT_TPU_EXEC_CACHE", exec_cache)
+
+    monkeypatch.setenv("RAFT_TPU_COMPILE_SERVICE", "0")
+    sweep_mod._TEMPLATE_MEMO.clear()
+    inline = _sweep()
+    _assert_same_results(baseline, inline)
+
+    for depth in ("1", "3"):
+        monkeypatch.setenv("RAFT_TPU_PIPELINE", depth)
+        monkeypatch.setenv("RAFT_TPU_COMPILE_SERVICE", "0")
+        sweep_mod._TEMPLATE_MEMO.clear()
+        off = _sweep()
+        monkeypatch.setenv("RAFT_TPU_COMPILE_SERVICE", "1")
+        sweep_mod._TEMPLATE_MEMO.clear()
+        on = _sweep()
+        _assert_same_results(off, on)
+        _assert_same_results(baseline, on)
