@@ -227,6 +227,16 @@ def main():
     }
     print(json.dumps(result))
 
+    # append the result line to the perf trajectory so the history
+    # store (python -m raft_tpu.obs.history) ingests runs, not
+    # BENCH_r0*.json filenames; RAFT_TPU_BENCH_HISTORY= (empty) disables
+    history_path = os.environ.get("RAFT_TPU_BENCH_HISTORY", "bench_history.jsonl")
+    if history_path:
+        stamped = dict(result)
+        stamped["t"] = time.time()
+        with open(history_path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(stamped) + "\n")
+
 
 if __name__ == "__main__":
     main()

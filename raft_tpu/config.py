@@ -156,12 +156,28 @@ def compile_config(overrides=None) -> dict:
 # work beyond a no-op method call per lifecycle point and never touches
 # a traced program).  `trace_dir` arms on-demand `jax.profiler.trace`
 # capture around the phases named in `trace_phases` (empty tuple =
-# every armed phase).  Environment overrides: RAFT_TPU_LEDGER=dir,
-# RAFT_TPU_TRACE=dir, RAFT_TPU_TRACE_PHASES=chunks,compile.
+# every armed phase).  `metrics` turns the live in-process metrics
+# registry ON (counters/gauges/histograms fed from the same emission
+# points as the ledger; off = the NULL registry, zero overhead);
+# `metrics_port` additionally starts the stdlib HTTP endpoint serving
+# Prometheus-text /metrics, JSON /status and /runs — setting the port
+# implies `metrics`.  The endpoint binds `metrics_host` (loopback by
+# default: the metrics surface is unauthenticated process state, so
+# exposing it beyond localhost is an explicit opt-in).  `history` is
+# the default cross-run history store consumed by
+# `python -m raft_tpu.obs.history`.  Environment overrides:
+# RAFT_TPU_LEDGER=dir, RAFT_TPU_TRACE=dir,
+# RAFT_TPU_TRACE_PHASES=chunks,compile, RAFT_TPU_METRICS=1,
+# RAFT_TPU_METRICS_PORT=9100 (0 = ephemeral),
+# RAFT_TPU_METRICS_HOST=addr, RAFT_TPU_HISTORY=path.
 OBS_DEFAULTS = {
     "ledger_dir": None,
     "trace_dir": None,
     "trace_phases": ("chunks",),
+    "metrics": False,
+    "metrics_port": None,
+    "metrics_host": "127.0.0.1",
+    "history": None,
 }
 
 
@@ -181,11 +197,25 @@ def obs_config(overrides=None) -> dict:
     if env is not None:
         cfg["trace_phases"] = tuple(
             p.strip() for p in env.split(",") if p.strip())
+    env = os.environ.get("RAFT_TPU_METRICS")
+    if env is not None:
+        cfg["metrics"] = env not in ("0", "false", "")
+    env = os.environ.get("RAFT_TPU_METRICS_PORT")
+    if env is not None:
+        cfg["metrics_port"] = int(env) if env != "" else None
+    env = os.environ.get("RAFT_TPU_METRICS_HOST")
+    if env:
+        cfg["metrics_host"] = env
+    env = os.environ.get("RAFT_TPU_HISTORY")
+    if env is not None:
+        cfg["history"] = env or None
     if overrides:
         unknown = set(overrides) - set(cfg)
         if unknown:
             raise ValueError(f"unknown obs config key(s): {sorted(unknown)}")
         cfg.update(overrides)
+    if cfg["metrics_port"] is not None:
+        cfg["metrics"] = True
     return cfg
 
 

@@ -28,9 +28,12 @@ from ..schema import get_from_dict, load_design, resolve_path
 from ..ops import waves
 from .. import profiling
 from ..mooring import system as moorsys
+from ..obs import log as obs_log
 from .fowt import FOWT, _sorted_eigen
 
 TwoPi = 2.0 * np.pi
+
+_LOG = obs_log.get_logger("core.model")
 
 
 def _plot_moor_segments(ax, pos, line_iA, line_iB, ix=None, color="b", lw=0.8):
@@ -197,8 +200,8 @@ class Model:
 
         for iCase in range(nCases):
             if display > 0:
-                print(f"\n--------------------- Running Case {iCase+1} ----------------------")
-                print(self.design["cases"]["data"][iCase])
+                obs_log.display(_LOG, f"\n--------------------- Running Case {iCase+1} ----------------------")
+                obs_log.display(_LOG, f"{self.design['cases']['data'][iCase]}")
             t_before = profiling.report()
 
             case = dict(zip(self.design["cases"]["keys"], self.design["cases"]["data"][iCase]))
@@ -220,7 +223,7 @@ class Model:
                 for ph, tot in profiling.report().items():
                     dt = tot - t_before.get(ph, 0.0)
                     if dt > 0:
-                        print(f"  [timing] {ph}: {dt:.3f} s")
+                        obs_log.display(_LOG, f"  [timing] {ph}: {dt:.3f} s")
             for i, fowt in enumerate(self.fowtList):
                 self.results["case_metrics"][iCase][i] = {}
                 fowt.saveTurbineOutputs(self.results["case_metrics"][iCase][i], case)
@@ -269,8 +272,8 @@ class Model:
         fns, modes = _sorted_eigen(M_tot, C_tot)
 
         if display > 0:
-            print("--------- Natural frequencies and mode shapes -------------")
-            print("Fn (Hz)" + "".join([f"{fn:10.4f}" for fn in fns]))
+            obs_log.display(_LOG, "--------- Natural frequencies and mode shapes -------------")
+            obs_log.display(_LOG, "Fn (Hz)" + "".join([f"{fn:10.4f}" for fn in fns]))
 
         self.results["eigen"] = {"frequencies": fns, "modes": modes}
         return fns, modes
@@ -409,8 +412,8 @@ class Model:
                 break
 
         if display > 0:
-            print("New Equilibrium Position", X)
-            print("Remaining Forces on the Model (N)", Y)
+            obs_log.display(_LOG, f"New Equilibrium Position {X}")
+            obs_log.display(_LOG, f"Remaining Forces on the Model (N) {Y}")
 
         if case and "iCase" in case:
             self.results.setdefault("mean_offsets", []).append(X.copy())
@@ -501,7 +504,7 @@ class Model:
                 else:
                     XiLast = 0.2 * XiLast + 0.8 * Xi
                 if iiter == nIter - 1 and display > 0:
-                    print("WARNING - solveDynamics iteration did not converge to the tolerance.")
+                    obs_log.display(_LOG, "WARNING - solveDynamics iteration did not converge to the tolerance.")
                 iiter += 1
 
             fowt.Z = np.asarray(Z)  # [6,6,nw], reference layout

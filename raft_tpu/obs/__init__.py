@@ -20,6 +20,14 @@ not scattered prints.  This package provides it in four layers:
 * :mod:`raft_tpu.obs.report` — ``python -m raft_tpu.obs.report <dir>``:
   phase waterfall, compile-vs-execute split, bytes moved, quarantine
   timeline, ETA accuracy.
+* :mod:`raft_tpu.obs.metrics` — live process-wide metrics registry
+  (``RAFT_TPU_METRICS``), fed from the same ledger emission points.
+* :mod:`raft_tpu.obs.live` — stdlib HTTP endpoint
+  (``RAFT_TPU_METRICS_PORT``): Prometheus ``/metrics``, JSON
+  ``/status`` + ``/runs`` while a sweep runs.
+* :mod:`raft_tpu.obs.history` — ``python -m raft_tpu.obs.history``:
+  append-only cross-run store ingesting ledgers + bench JSON;
+  ``compare``/``check`` turn it into an automated perf-regression gate.
 
 See docs/observability.md.
 """
@@ -32,9 +40,10 @@ from .ledger import (  # noqa: F401
     emit_device_memory,
     enabled,
     list_runs,
+    observing,
     read_events,
     start_run,
     tree_nbytes,
 )
-from .log import display, get_logger, warn  # noqa: F401
+from .log import display, get_logger, warn, warn_once  # noqa: F401
 from .trace import maybe_trace  # noqa: F401

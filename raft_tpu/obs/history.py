@@ -1,0 +1,583 @@
+"""Cross-run history store + automated perf-regression gate.
+
+The ledger makes ONE run explainable; this module remembers MANY.
+``python -m raft_tpu.obs.history`` maintains an append-only JSONL index
+(one summary record per run) built by ingesting ledger files and bench
+result JSON, and answers the two questions a perf trajectory exists
+for: "how does this run compare to the last one like it?" and "did we
+regress?" — the latter as a nonzero-exit ``check`` mode wired into CI.
+
+Records carry a **fingerprint key**: a stable hash of the run's
+design/axes fingerprint (ledger ``run_start``) or bench workload name,
+so comparisons only ever pair runs of the SAME workload.  ``check``
+compares the newest record against a rolling-median baseline of prior
+matching records with a configurable relative tolerance, plus absolute
+``--require name<=value`` constraints (CI uses ``real_compiles<=0`` to
+pin the exec-cache warm start).
+
+Subcommands::
+
+    ingest <ledger.jsonl|ledger-dir|bench.json|bench_history.jsonl>...
+                                  --store history.jsonl
+    list    --store history.jsonl [--kind sweep]
+    compare --store history.jsonl [A B]     # default: newest matching pair
+    check   --store history.jsonl [--tolerance 0.25] [--window 5]
+            [--metrics wall_s,chunk_mean_s] [--require real_compiles<=0]
+
+Store records are plain JSON — the (design -> metrics, cost) provenance
+the ROM/gradient tiers (ROADMAP items 2, 5) will train and gate on.
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import re
+import sys
+
+from ..config import obs_config
+from . import ledger as obs_ledger
+
+__all__ = [
+    "summarize_ledger", "summarize_bench", "load_store", "append_records",
+    "ingest_paths", "matching_records", "compare_records", "run_check",
+    "main",
+]
+
+SCHEMA = 1
+
+# metrics `check` watches by default; all are regressions when they go UP
+DEFAULT_TRACKED = ("wall_s", "chunk_mean_s", "real_compiles")
+
+
+def _fp_key(fingerprint) -> str | None:
+    """Stable short hash of a run fingerprint (workload identity)."""
+    if fingerprint in (None, {}, ""):
+        return None
+    blob = json.dumps(fingerprint, sort_keys=True, default=repr)
+    return hashlib.sha1(blob.encode("utf-8")).hexdigest()[:12]
+
+
+def summarize_ledger(path) -> dict | None:
+    """One history record from one ledger file (None if unusably empty).
+
+    Scalar metrics are derived, not copied: wall clock from the
+    run_start/run_end stamps, per-chunk seconds from the dispatch ->
+    commit spans, compile counts from ``compile_start(real=...)``, cache
+    activity from the ``exec_cache_*`` events, bytes from ``transfer`` +
+    ``chunk_fetch``.
+    """
+    events = obs_ledger.read_events(path)
+    if not events or events[0].get("event") != "run_start":
+        return None
+    start = events[0]
+    by: dict = {}
+    for ev in events:
+        by.setdefault(ev.get("event", "?"), []).append(ev)
+    end = (by.get("run_end") or [None])[-1]
+
+    dispatch_t = {}
+    chunk_seconds: dict = {}
+    for ev in by.get("chunk_dispatch", ()):
+        dispatch_t[ev.get("chunk")] = ev.get("t")
+    for ev in by.get("chunk_commit", ()):
+        c = ev.get("chunk")
+        if c in dispatch_t and ev.get("t") is not None:
+            chunk_seconds[c] = round(ev["t"] - dispatch_t[c], 6)
+    chunks = [chunk_seconds[c] for c in sorted(chunk_seconds)]
+
+    metrics: dict = {
+        "real_compiles": sum(1 for ev in by.get("compile_start", ())
+                             if ev.get("real")),
+        "compiles_submitted": len(by.get("compile_submitted", ())),
+        "exec_cache_hits": len(by.get("exec_cache_hit", ())),
+        "exec_cache_misses": len(by.get("exec_cache_miss", ())),
+        "exec_cache_rejects": len(by.get("exec_cache_reject", ())),
+        "chunks_committed": len(by.get("chunk_commit", ())),
+        "quarantine_retries": len(by.get("quarantine_retry", ())),
+        "designs_quarantined": sum(len(ev.get("designs") or ())
+                                   for ev in by.get("design_quarantined", ())),
+        "warnings": len(by.get("warning", ())),
+    }
+    if end is not None and end.get("t") and start.get("t"):
+        metrics["wall_s"] = round(end["t"] - start["t"], 6)
+    if chunks:
+        metrics["chunk_mean_s"] = round(sum(chunks) / len(chunks), 6)
+        metrics["chunk_max_s"] = round(max(chunks), 6)
+    compile_s = [ev.get("seconds") for ev in by.get("compile_end", ())
+                 if isinstance(ev.get("seconds"), (int, float))]
+    if compile_s:
+        metrics["compile_total_s"] = round(sum(compile_s), 6)
+    ov = (by.get("compile_overlap") or [None])[-1]
+    if ov is not None and isinstance(ov.get("stall_s"), (int, float)):
+        metrics["first_dispatch_stall_s"] = ov["stall_s"]
+    h2d = sum(ev.get("bytes", 0) for ev in by.get("transfer", ())
+              if ev.get("direction") == "h2d")
+    d2h = (sum(ev.get("bytes", 0) for ev in by.get("transfer", ())
+               if ev.get("direction") == "d2h")
+           + sum(ev.get("bytes", 0) for ev in by.get("chunk_fetch", ())))
+    if h2d:
+        metrics["h2d_bytes"] = h2d
+    if d2h:
+        metrics["d2h_bytes"] = d2h
+
+    phase_totals = {ev["name"]: ev.get("total")
+                    for ev in by.get("phase_stats", ())
+                    if ev.get("name") is not None}
+
+    fingerprint = start.get("fingerprint")
+    return {
+        "schema": SCHEMA,
+        "source": "ledger",
+        "run_id": start.get("run_id"),
+        "kind": start.get("kind"),
+        "t_start": start.get("t"),
+        "ok": None if end is None else bool(end.get("ok")),
+        "fingerprint": fingerprint,
+        "fp_key": _fp_key(fingerprint),
+        "metrics": metrics,
+        "phase_totals": phase_totals,
+        "chunk_seconds": chunks,
+        "ingested_from": os.path.abspath(path),
+    }
+
+
+def summarize_bench(obj, path="") -> dict | None:
+    """One history record from one bench result line (bench.py JSON)."""
+    if not isinstance(obj, dict) or "metric" not in obj:
+        return None
+    detail = obj.get("detail") or {}
+    metrics = {"wall_s": obj.get("value")}
+    for key in ("cold_s", "repeat_sweep_s", "designs_per_sec_repeat",
+                "designs_per_sec_execution", "repeat_xla_compiles"):
+        if isinstance(detail.get(key), (int, float)):
+            metrics[key] = detail[key]
+    if isinstance(detail.get("repeat_xla_compiles"), int):
+        metrics["real_compiles"] = detail["repeat_xla_compiles"]
+    fingerprint = {"bench_metric": obj.get("metric")}
+    return {
+        "schema": SCHEMA,
+        "source": "bench",
+        "run_id": obj.get("run_id") or f"bench-{_fp_key({'m': obj.get('metric'), 't': obj.get('t')})}-{obj.get('t', '')}",
+        "kind": "bench",
+        "t_start": obj.get("t"),
+        "ok": True,
+        "fingerprint": fingerprint,
+        "fp_key": _fp_key(fingerprint),
+        "metrics": {k: v for k, v in metrics.items() if v is not None},
+        "phase_totals": {k: v for k, v in
+                         (detail.get("repeat_phases_s") or {}).items()
+                         if isinstance(v, (int, float))},
+        "chunk_seconds": [],
+        "ingested_from": os.path.abspath(path) if path else "",
+    }
+
+
+# ---------------------------------------------------------------------------
+# store
+# ---------------------------------------------------------------------------
+
+def default_store() -> str | None:
+    return obs_config()["history"]
+
+
+def load_store(store_path) -> list:
+    """Decode the store, skipping truncated/foreign lines."""
+    records = []
+    if not store_path or not os.path.exists(store_path):
+        return records
+    with open(store_path, encoding="utf-8") as fh:
+        for line in fh:
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except ValueError:
+                continue
+            if isinstance(rec, dict) and rec.get("run_id"):
+                records.append(rec)
+    return records
+
+
+def append_records(store_path, records) -> int:
+    """Append new records, deduplicating on (source, run_id)."""
+    existing = {(r.get("source"), r.get("run_id"))
+                for r in load_store(store_path)}
+    fresh = [r for r in records
+             if (r.get("source"), r.get("run_id")) not in existing]
+    if not fresh:
+        return 0
+    parent = os.path.dirname(os.path.abspath(store_path))
+    os.makedirs(parent, exist_ok=True)
+    with open(store_path, "a", encoding="utf-8") as fh:
+        for rec in fresh:
+            fh.write(json.dumps(rec, sort_keys=True) + "\n")
+    return len(fresh)
+
+
+def _records_from_path(path):
+    """Yield history records from one input path: a ledger file, a
+    ledger dir, a bench result JSON, or a bench_history.jsonl."""
+    if os.path.isdir(path):
+        for p in obs_ledger.list_runs(path):
+            rec = summarize_ledger(p)
+            if rec is not None:
+                yield rec
+        return
+    with open(path, encoding="utf-8") as fh:
+        head = fh.read(4096).lstrip()
+    looks_ledger = '"event"' in head and '"seq"' in head
+    if looks_ledger:
+        rec = summarize_ledger(path)
+        if rec is not None:
+            yield rec
+        return
+    # bench: one pretty-printed JSON object or JSONL of result lines
+    with open(path, encoding="utf-8") as fh:
+        text = fh.read()
+    try:
+        obj = json.loads(text)
+        objs = obj if isinstance(obj, list) else [obj]
+    except ValueError:
+        objs = []
+        for line in text.splitlines():
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                objs.append(json.loads(line))
+            except ValueError:
+                continue
+    for i, obj in enumerate(objs):
+        rec = summarize_bench(obj, path=f"{path}#{i}" if len(objs) > 1 else path)
+        if rec is not None:
+            yield rec
+
+
+def ingest_paths(store_path, paths) -> int:
+    records = []
+    for path in paths:
+        records.extend(_records_from_path(path))
+    return append_records(store_path, records)
+
+
+# ---------------------------------------------------------------------------
+# compare / check
+# ---------------------------------------------------------------------------
+
+def matching_records(records, ref) -> list:
+    """Prior records with ``ref``'s workload identity (kind + fp_key),
+    oldest first, excluding ``ref`` itself."""
+    return [r for r in records
+            if r is not ref
+            and r.get("kind") == ref.get("kind")
+            and r.get("fp_key") == ref.get("fp_key")]
+
+
+def _median(values):
+    vs = sorted(values)
+    n = len(vs)
+    if not n:
+        return None
+    mid = n // 2
+    return vs[mid] if n % 2 else (vs[mid - 1] + vs[mid]) / 2.0
+
+
+def compare_records(old, new) -> dict:
+    """Per-metric, per-phase, and per-chunk deltas between two runs."""
+    deltas = {}
+    for name in sorted(set(old.get("metrics", {})) | set(new.get("metrics", {}))):
+        a = old.get("metrics", {}).get(name)
+        b = new.get("metrics", {}).get(name)
+        entry = {"old": a, "new": b}
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            entry["delta"] = round(b - a, 6)
+            if a:
+                entry["ratio"] = round(b / a, 4)
+        deltas[name] = entry
+    phases = {}
+    for name in sorted(set(old.get("phase_totals", {}))
+                       | set(new.get("phase_totals", {}))):
+        a = old.get("phase_totals", {}).get(name)
+        b = new.get("phase_totals", {}).get(name)
+        entry = {"old": a, "new": b}
+        if isinstance(a, (int, float)) and isinstance(b, (int, float)):
+            entry["delta"] = round(b - a, 6)
+        phases[name] = entry
+    ca, cb = old.get("chunk_seconds") or [], new.get("chunk_seconds") or []
+    chunks = None
+    if ca and cb:
+        n = min(len(ca), len(cb))
+        per = [round(cb[i] - ca[i], 6) for i in range(n)]
+        chunks = {
+            "n_compared": n,
+            "mean_delta_s": round(sum(per) / n, 6),
+            "max_delta_s": round(max(per), 6),
+            "per_chunk_delta_s": per,
+        }
+    return {"old_run": old.get("run_id"), "new_run": new.get("run_id"),
+            "metrics": deltas, "phases": phases, "chunks": chunks}
+
+
+_REQUIRE_RE = re.compile(r"^\s*([A-Za-z_][\w.]*)\s*(<=|>=|==|<|>)\s*(-?[\d.]+)\s*$")
+_REQUIRE_OPS = {
+    "<=": lambda a, b: a <= b,
+    ">=": lambda a, b: a >= b,
+    "==": lambda a, b: a == b,
+    "<": lambda a, b: a < b,
+    ">": lambda a, b: a > b,
+}
+
+
+def parse_require(expr):
+    m = _REQUIRE_RE.match(expr)
+    if not m:
+        raise ValueError(
+            f"bad --require {expr!r} (want e.g. real_compiles<=0)")
+    name, op, value = m.groups()
+    return name, op, float(value)
+
+
+def run_check(records, tolerance=0.25, window=5, tracked=DEFAULT_TRACKED,
+              requires=(), min_delta=0.0) -> dict:
+    """The perf gate: newest record vs a rolling-median baseline.
+
+    Baseline = per-metric median over the last ``window`` prior records
+    sharing the newest record's workload identity.  A tracked metric
+    regresses when ``new > baseline * (1 + tolerance)`` AND the absolute
+    increase exceeds ``min_delta`` (guards sub-resolution jitter on
+    near-zero baselines).  ``requires`` are absolute constraints on the
+    newest record, enforced even with no baseline (the
+    no-matching-fingerprint case passes the relative gate vacuously).
+    """
+    result = {"ok": True, "failures": [], "checks": [], "notes": []}
+    if not records:
+        result["notes"].append("empty store: nothing to check")
+        return result
+    newest = records[-1]
+    result["run_id"] = newest.get("run_id")
+    baseline_pool = matching_records(records, newest)[-window:]
+    result["baseline_runs"] = [r.get("run_id") for r in baseline_pool]
+    if not baseline_pool:
+        result["notes"].append(
+            f"no prior record matches fingerprint {newest.get('fp_key')!r} "
+            f"(kind {newest.get('kind')!r}); relative gate skipped")
+    for name in tracked:
+        new_v = newest.get("metrics", {}).get(name)
+        base_vs = [r.get("metrics", {}).get(name) for r in baseline_pool]
+        base_vs = [v for v in base_vs if isinstance(v, (int, float))]
+        if not isinstance(new_v, (int, float)) or not base_vs:
+            continue
+        base = _median(base_vs)
+        limit = base * (1.0 + tolerance)
+        regressed = new_v > limit and (new_v - base) > min_delta
+        result["checks"].append({
+            "metric": name, "new": new_v, "baseline": round(base, 6),
+            "limit": round(limit, 6), "n_baseline": len(base_vs),
+            "ok": not regressed,
+        })
+        if regressed:
+            result["ok"] = False
+            result["failures"].append(
+                f"{name}: {new_v} > {round(limit, 6)} "
+                f"(baseline median {round(base, 6)} over "
+                f"{len(base_vs)} run(s), tolerance {tolerance:g})")
+    for expr in requires:
+        name, op, value = parse_require(expr) if isinstance(expr, str) else expr
+        new_v = newest.get("metrics", {}).get(name)
+        ok = isinstance(new_v, (int, float)) and _REQUIRE_OPS[op](new_v, value)
+        result["checks"].append({"require": f"{name}{op}{value:g}",
+                                 "new": new_v, "ok": ok})
+        if not ok:
+            result["ok"] = False
+            result["failures"].append(
+                f"require {name}{op}{value:g} failed: "
+                f"{name}={new_v!r} on run {newest.get('run_id')}")
+    return result
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+def _fmt_num(v):
+    if isinstance(v, float):
+        return f"{v:.4g}"
+    return str(v)
+
+
+def _cmd_ingest(args):
+    n = ingest_paths(args.store, args.paths)
+    print(f"ingested {n} new record(s) into {args.store}")
+    return 0
+
+
+def _cmd_list(args):
+    records = load_store(args.store)
+    if args.kind:
+        records = [r for r in records if r.get("kind") == args.kind]
+    if not records:
+        print("(empty)")
+        return 0
+    for r in records:
+        m = r.get("metrics", {})
+        bits = [f"{r.get('run_id')}", f"kind={r.get('kind')}",
+                f"fp={r.get('fp_key')}"]
+        for name in ("wall_s", "chunk_mean_s", "real_compiles",
+                     "chunks_committed"):
+            if name in m:
+                bits.append(f"{name}={_fmt_num(m[name])}")
+        if r.get("ok") is False:
+            bits.append("FAILED")
+        print("  ".join(bits))
+    return 0
+
+
+def _find(records, token):
+    matches = [r for r in records
+               if str(r.get("run_id", "")).startswith(token)]
+    if len(matches) != 1:
+        raise SystemExit(
+            f"run id {token!r} matches {len(matches)} record(s)")
+    return matches[0]
+
+
+def _cmd_compare(args):
+    records = load_store(args.store)
+    if args.runs:
+        if len(args.runs) != 2:
+            raise SystemExit("compare takes exactly 0 or 2 run ids")
+        old, new = (_find(records, t) for t in args.runs)
+    else:
+        if not records:
+            raise SystemExit("empty store")
+        new = records[-1]
+        pool = matching_records(records, new)
+        if not pool:
+            print(f"no prior record matches fingerprint "
+                  f"{new.get('fp_key')!r}; nothing to compare")
+            return 0
+        old = pool[-1]
+    cmp = compare_records(old, new)
+    if args.json:
+        print(json.dumps(cmp, indent=2))
+        return 0
+    print(f"old: {cmp['old_run']}\nnew: {cmp['new_run']}")
+    print("metrics:")
+    for name, e in cmp["metrics"].items():
+        line = (f"  {name:<24} {_fmt_num(e.get('old'))} -> "
+                f"{_fmt_num(e.get('new'))}")
+        if "delta" in e:
+            line += f"  ({e['delta']:+g}"
+            if "ratio" in e:
+                line += f", x{e['ratio']:g}"
+            line += ")"
+        print(line)
+    if cmp["phases"]:
+        print("phase totals [s]:")
+        for name, e in cmp["phases"].items():
+            line = (f"  {name:<32} {_fmt_num(e.get('old'))} -> "
+                    f"{_fmt_num(e.get('new'))}")
+            if "delta" in e:
+                line += f"  ({e['delta']:+g})"
+            print(line)
+    if cmp["chunks"]:
+        c = cmp["chunks"]
+        print(f"chunks ({c['n_compared']} compared): "
+              f"mean {c['mean_delta_s']:+g} s, max {c['max_delta_s']:+g} s")
+    return 0
+
+
+def _cmd_check(args):
+    records = load_store(args.store)
+    tracked = (tuple(t for t in args.metrics.split(",") if t)
+               if args.metrics else DEFAULT_TRACKED)
+    requires = [parse_require(e) for e in (args.require or [])]
+    result = run_check(records, tolerance=args.tolerance,
+                       window=args.window, tracked=tracked,
+                       requires=requires, min_delta=args.min_delta)
+    if args.json:
+        print(json.dumps(result, indent=2))
+    else:
+        for note in result["notes"]:
+            print(f"note: {note}")
+        for c in result["checks"]:
+            tag = "ok " if c["ok"] else "FAIL"
+            if "require" in c:
+                print(f"[{tag}] require {c['require']}: new={c['new']!r}")
+            else:
+                print(f"[{tag}] {c['metric']}: new={_fmt_num(c['new'])} "
+                      f"baseline={_fmt_num(c['baseline'])} "
+                      f"limit={_fmt_num(c['limit'])} "
+                      f"(n={c['n_baseline']})")
+        if result["ok"]:
+            print("perf gate: PASS")
+        else:
+            print("perf gate: FAIL")
+            for f in result["failures"]:
+                print(f"  {f}")
+    return 0 if result["ok"] else 1
+
+
+def main(argv=None) -> int:
+    parser = argparse.ArgumentParser(
+        prog="python -m raft_tpu.obs.history",
+        description="Cross-run history store + perf-regression gate")
+    sub = parser.add_subparsers(dest="cmd", required=True)
+
+    def add_store(p):
+        p.add_argument("--store", default=default_store(),
+                       help="history JSONL path (default: RAFT_TPU_HISTORY)")
+
+    p = sub.add_parser("ingest", help="summarize ledgers/bench JSON into the store")
+    add_store(p)
+    p.add_argument("paths", nargs="+",
+                   help="ledger .jsonl file(s), ledger dir(s), bench JSON, "
+                        "or bench_history.jsonl")
+    p.set_defaults(fn=_cmd_ingest)
+
+    p = sub.add_parser("list", help="list stored run summaries")
+    add_store(p)
+    p.add_argument("--kind", default=None)
+    p.set_defaults(fn=_cmd_list)
+
+    p = sub.add_parser("compare", help="per-metric/phase/chunk deltas between two runs")
+    add_store(p)
+    p.add_argument("runs", nargs="*",
+                   help="two run-id prefixes (default: newest matching pair)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_compare)
+
+    p = sub.add_parser("check", help="perf gate: newest run vs rolling baseline")
+    add_store(p)
+    p.add_argument("--tolerance", type=float, default=0.25,
+                   help="relative regression tolerance (default 0.25)")
+    p.add_argument("--window", type=int, default=5,
+                   help="rolling-baseline size (default 5)")
+    p.add_argument("--min-delta", type=float, default=0.0,
+                   help="absolute increase a regression must also exceed")
+    p.add_argument("--metrics", default=None,
+                   help=f"comma-separated tracked metrics "
+                        f"(default {','.join(DEFAULT_TRACKED)})")
+    p.add_argument("--require", action="append", default=[],
+                   metavar="NAME<=VALUE",
+                   help="absolute constraint on the newest run (repeatable)")
+    p.add_argument("--json", action="store_true")
+    p.set_defaults(fn=_cmd_check)
+
+    args = parser.parse_args(argv)
+    if not args.store:
+        parser.error("--store is required (or set RAFT_TPU_HISTORY)")
+    try:
+        return args.fn(args)
+    except BrokenPipeError:
+        # downstream pager/head closed the pipe; not an error
+        sys.stderr.close()
+        return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

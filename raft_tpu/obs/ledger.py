@@ -9,12 +9,22 @@ that used to live in four uncorrelated fragments — phase timers
 report, and bench ``detail`` blobs — land in one file, keyed by one id,
 renderable by ``python -m raft_tpu.obs.report``.
 
-Off by default.  When ``RAFT_TPU_LEDGER`` is unset, :func:`start_run`
-returns the :data:`NULL_RUN` singleton whose ``emit``/``close`` are
-no-ops and whose ``enabled`` flag gates every byte-counting or
-stat-gathering expression at the call sites — the telemetry-off sweep
-path does no extra host work and (by construction: nothing here touches
+Off by default.  When ``RAFT_TPU_LEDGER`` is unset and live metrics
+(:mod:`raft_tpu.obs.metrics`) are off, :func:`start_run` returns the
+:data:`NULL_RUN` singleton whose ``emit``/``close`` are no-ops and
+whose ``enabled`` flag gates every byte-counting or stat-gathering
+expression at the call sites — the telemetry-off sweep path does no
+extra host work and (by construction: nothing here touches
 jit/lowering) compiles no extra XLA programs.
+
+The ledger is also the live-metrics emission point: when metrics are
+armed (``RAFT_TPU_METRICS``/``RAFT_TPU_METRICS_PORT``), every record a
+``Run`` emits is forwarded to :func:`raft_tpu.obs.metrics.observe_event`
+so counters/gauges/histograms and the ledger file derive from ONE call
+site per seam.  With metrics on but the ledger off, :func:`start_run`
+hands out a *file-less* ``Run`` (``path is None``): all the existing
+``run.enabled`` guards keep gating the stat-gathering, and the events
+flow to the registry without touching disk.
 
 Thread-safety: one run's events may be emitted from the sweep's main
 thread, the AOT compile workers, and the background checkpoint-writer
@@ -38,16 +48,25 @@ import numpy as np
 
 from .. import profiling
 from ..config import obs_config
+from . import metrics
 
 __all__ = [
     "Run", "NULL_RUN", "start_run", "current_run", "emit", "enabled",
-    "emit_device_memory", "tree_nbytes", "list_runs", "read_events",
+    "observing", "emit_device_memory", "tree_nbytes", "list_runs",
+    "read_events",
 ]
 
 
 def enabled() -> bool:
     """True when the ledger is armed (``RAFT_TPU_LEDGER`` set)."""
     return obs_config()["ledger_dir"] is not None
+
+
+def observing() -> bool:
+    """True when ANY event consumer is armed — the ledger file or the
+    live metrics registry.  The gate sweep()/precompile() use to decide
+    whether to open a :class:`Run` at all."""
+    return enabled() or metrics.enabled()
 
 
 def _jsonable(obj):
@@ -117,17 +136,28 @@ class Run:
     enabled = True
 
     def __init__(self, kind, ledger_dir, fingerprint=None, meta=None):
-        os.makedirs(ledger_dir, exist_ok=True)
         stamp = time.strftime("%Y%m%dT%H%M%S")
         self.run_id = f"{stamp}-{kind}-{os.getpid()}-{time.time_ns() % 10**6:06d}"
         self.kind = kind
-        self.path = os.path.join(ledger_dir, f"{self.run_id}.jsonl")
+        if ledger_dir is not None:
+            os.makedirs(ledger_dir, exist_ok=True)
+            self.path = os.path.join(ledger_dir, f"{self.run_id}.jsonl")
+            self._fh = open(self.path, "a", encoding="utf-8")
+        else:
+            # file-less run: metrics-only observation (see module doc)
+            self.path = None
+            self._fh = None
         self._t0 = time.time()
         self._seq = 0
         self._lock = threading.Lock()
         self._closed = False
         self._phase_agg: dict = {}
-        self._fh = open(self.path, "a", encoding="utf-8")
+        # latched per run so a mid-run env flip can't tear the stream
+        self._metrics = metrics.enabled()
+        if self._metrics:
+            from . import live
+
+            live.ensure_server()
         _ACTIVE.append(self)
         self._listener = self._on_phase
         profiling.add_listener(self._listener)
@@ -137,7 +167,12 @@ class Run:
     # -- emission ---------------------------------------------------------
 
     def emit(self, event, **fields):
-        """Append one typed event (thread-safe; drops after close)."""
+        """Append one typed event (thread-safe; drops after close).
+
+        When live metrics are armed, the same record is forwarded to
+        the registry AFTER the run lock is released (observe_event has
+        its own per-instrument locking; holding the emit lock across it
+        would serialize the compile workers on histogram updates)."""
         with self._lock:
             if self._closed:
                 return
@@ -145,8 +180,11 @@ class Run:
             rec = {"t": round(time.time(), 6), "seq": self._seq,
                    "event": event}
             rec.update(fields)
-            self._fh.write(json.dumps(rec, default=_jsonable) + "\n")
-            self._fh.flush()
+            if self._fh is not None:
+                self._fh.write(json.dumps(rec, default=_jsonable) + "\n")
+                self._fh.flush()
+        if self._metrics:
+            metrics.observe_event(event, rec)
 
     def elapsed(self) -> float:
         return time.time() - self._t0
@@ -204,7 +242,8 @@ class Run:
         self._flush_phase_stats()
         with self._lock:
             self._closed = True
-            self._fh.close()
+            if self._fh is not None:
+                self._fh.close()
         if self in _ACTIVE:
             _ACTIVE.remove(self)
 
@@ -217,13 +256,15 @@ class Run:
 
 
 def start_run(kind, fingerprint=None, meta=None):
-    """Open a ledger run, or return :data:`NULL_RUN` when disabled.
+    """Open a run, or return :data:`NULL_RUN` when nothing observes.
 
-    The env knob is re-read per call (not latched at import), so tests
-    and drivers can arm/disarm the ledger around individual sweeps.
+    The env knobs are re-read per call (not latched at import), so tests
+    and drivers can arm/disarm the ledger/metrics around individual
+    sweeps.  Ledger on → file-backed run; ledger off but metrics on →
+    file-less run feeding the registry only; both off → NULL_RUN.
     """
     ledger_dir = obs_config()["ledger_dir"]
-    if ledger_dir is None:
+    if ledger_dir is None and not metrics.enabled():
         return NULL_RUN
     return Run(kind, ledger_dir, fingerprint=fingerprint, meta=meta)
 
@@ -233,11 +274,14 @@ def emit_device_memory(run, device=None, what=""):
 
     ``memory_stats()`` is a per-backend optional API (TPU reports
     ``bytes_in_use``/``peak_bytes_in_use``; CPU returns None) — absence
-    is recorded as nulls, never an error.
+    is recorded with ``supported=false`` (so dashboards can distinguish
+    "zero bytes" from "not measured") plus a one-time warning, never an
+    error.
     """
     if not run.enabled:
         return
     bytes_in_use = peak = err = None
+    supported = False
     name = str(device) if device is not None else None
     try:
         import jax
@@ -246,12 +290,23 @@ def emit_device_memory(run, device=None, what=""):
         name = str(d)
         stats = d.memory_stats()
         if stats:
+            supported = True
             bytes_in_use = int(stats.get("bytes_in_use", 0)) or None
             peak = int(stats.get("peak_bytes_in_use", 0)) or None
     except Exception as e:  # noqa: BLE001 - telemetry must never kill the run
         err = f"{type(e).__name__}: {e}"
+    if not supported:
+        # lazy import: log.py imports this module at its top level
+        from . import log as obs_log
+
+        obs_log.warn_once(
+            obs_log.get_logger("obs.ledger"),
+            ("device-memory-unsupported", name),
+            f"device {name or '?'} reports no memory_stats(); "
+            "device_memory events will carry supported=false"
+            + (f" ({err})" if err else ""))
     run.emit("device_memory", device=name, bytes_in_use=bytes_in_use,
-             peak_bytes=peak, what=what, error=err)
+             peak_bytes=peak, what=what, supported=supported, error=err)
 
 
 def tree_nbytes(tree) -> int:

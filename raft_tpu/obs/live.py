@@ -1,0 +1,158 @@
+"""Opt-in HTTP endpoint exposing live sweep state.
+
+Set ``RAFT_TPU_METRICS_PORT=<port>`` (which also arms the metrics
+registry) and any HTTP client can watch a sweep from outside the
+process while it runs:
+
+* ``GET /metrics`` — the registry in Prometheus text exposition format
+  (scrape it with a stock Prometheus / curl / promtool).
+* ``GET /status``  — JSON: active run id, lifecycle phase, chunk
+  progress and live ETA (the ledger's own ``chunk_commit`` ETA
+  accounting), per-design status tallies.
+* ``GET /runs``    — JSON list of recent finished-run summaries.
+
+This is deliberately the embryo of ``raft_tpu/serve/`` (ROADMAP item
+1): it exercises the "report on a sweep from another thread while the
+sweep owns the devices" seam that cross-request coalescing needs,
+without yet accepting work over the wire.
+
+Security: the server is unauthenticated and reports process internals,
+so it binds loopback (``127.0.0.1``) unless ``RAFT_TPU_METRICS_HOST``
+says otherwise.  Everything is stdlib (:mod:`http.server` with the
+threading mixin); requests are served on daemon threads and never touch
+JAX, so a scrape cannot perturb the sweep beyond a GIL timeslice.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+from ..config import obs_config
+from . import metrics
+
+__all__ = ["ensure_server", "stop_server", "server_address", "LiveServer"]
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "raft-tpu-live/1"
+
+    def _send(self, code, body, content_type):
+        data = body.encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", content_type)
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/metrics":
+                self._send(200, metrics.render_prometheus(),
+                           "text/plain; version=0.0.4; charset=utf-8")
+            elif path == "/status":
+                self._send(200, json.dumps(metrics.status_snapshot()),
+                           "application/json")
+            elif path == "/runs":
+                self._send(200, json.dumps({"runs": metrics.recent_runs()}),
+                           "application/json")
+            elif path == "/":
+                self._send(200, json.dumps(
+                    {"endpoints": ["/metrics", "/status", "/runs"]}),
+                    "application/json")
+            else:
+                self._send(404, json.dumps({"error": "not found",
+                                            "path": path}),
+                           "application/json")
+        except Exception as e:  # noqa: BLE001 - a bad scrape must not kill the thread
+            try:
+                self._send(500, json.dumps(
+                    {"error": f"{type(e).__name__}: {e}"}),
+                    "application/json")
+            except OSError:
+                pass
+
+    def log_message(self, fmt, *args):
+        # route access logs through the obs logger at debug, not stderr
+        from . import log as obs_log
+
+        obs_log.get_logger("obs.live").debug(
+            "%s %s", self.address_string(), fmt % args)
+
+
+class LiveServer:
+    """One ThreadingHTTPServer on a daemon thread."""
+
+    def __init__(self, host, port):
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="raft-tpu-live",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+
+_SERVER: LiveServer | None = None
+_SERVER_LOCK = threading.Lock()
+
+
+def ensure_server():
+    """Start the endpoint if configured and not yet running.
+
+    Idempotent and cheap when unconfigured — called from every
+    ``Run.__init__`` so merely starting an observed sweep brings the
+    endpoint up.  Port 0 binds an ephemeral port (tests); the bound
+    address is available via :func:`server_address`.  A bind failure
+    (port in use) warns once rather than killing the sweep.
+    """
+    global _SERVER
+    cfg = obs_config()
+    port = cfg["metrics_port"]
+    if port is None:
+        return None
+    with _SERVER_LOCK:
+        if _SERVER is not None:
+            return _SERVER
+        try:
+            _SERVER = LiveServer(cfg["metrics_host"], int(port))
+        except OSError as e:
+            from . import log as obs_log
+
+            logger = obs_log.get_logger("obs.live")
+            obs_log.warn_once(
+                logger, "live-bind-failed",
+                f"metrics endpoint bind failed on "
+                f"{cfg['metrics_host']}:{port}: {e}")
+            return None
+        from . import log as obs_log
+
+        obs_log.get_logger("obs.live").info(
+            "live metrics endpoint on %s", _SERVER.url)
+        return _SERVER
+
+
+def server_address():
+    """``(host, port)`` of the running endpoint, or None."""
+    with _SERVER_LOCK:
+        return (_SERVER.host, _SERVER.port) if _SERVER else None
+
+
+def stop_server():
+    """Shut the endpoint down (tests; long-lived processes keep it)."""
+    global _SERVER
+    with _SERVER_LOCK:
+        srv, _SERVER = _SERVER, None
+    if srv is not None:
+        srv.close()

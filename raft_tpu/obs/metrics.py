@@ -1,0 +1,621 @@
+"""Live process-wide metrics registry for sweeps and serving.
+
+The run ledger (:mod:`raft_tpu.obs.ledger`) makes a single sweep
+explainable *after* the fact; a resident multi-tenant solve server
+(ROADMAP item 1) needs the live half: scrapeable counters, gauges, and
+histograms that answer "what is this process doing right now" while a
+sweep runs.  This module is that registry, deliberately stdlib-only and
+Prometheus-text-compatible so any scraper works unmodified.
+
+Design points:
+
+* **One emission point.**  The instruments are fed from the SAME ledger
+  emissions the hot seams already make: :meth:`raft_tpu.obs.ledger.Run.emit`
+  forwards every event to :func:`observe_event`, which maps the typed
+  vocabulary (:mod:`raft_tpu.obs.schema`) onto instruments.  Code that
+  emits a ``chunk_dispatch`` event never grows a second, parallel
+  metrics call site — and with the ledger *file* off but metrics on,
+  ``start_run`` still hands out a (file-less) ``Run`` so the emission
+  points keep working (see ``ledger.start_run``).
+* **Zero-overhead-off.**  With metrics disabled (the default),
+  :func:`std` returns :data:`NULL_STD` — every instrument operation is a
+  no-op attribute access — and the ledger never calls
+  :func:`observe_event` at all.  Nothing here touches jit/lowering, so
+  metrics-on and metrics-off sweeps are bit-identical with zero extra
+  XLA compiles (sentinel-pinned in tests/test_obs.py).
+* **Lock-per-instrument.**  Each instrument serializes its own updates;
+  there is no registry-wide hot lock.  Emitters run on the sweep main
+  thread, the compile workers, and the checkpoint-writer thread.
+
+Enable with ``RAFT_TPU_METRICS=1`` (registry only) or
+``RAFT_TPU_METRICS_PORT=<port>`` (registry + the HTTP endpoint,
+:mod:`raft_tpu.obs.live`).  See docs/observability.md.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+from ..config import obs_config
+
+__all__ = [
+    "Counter", "Gauge", "Histogram", "MetricsRegistry",
+    "REGISTRY", "NULL_STD", "enabled", "std", "registry",
+    "observe_event", "render_prometheus", "status_snapshot",
+    "recent_runs", "reset",
+]
+
+
+def enabled() -> bool:
+    """True when the metrics registry is armed (``RAFT_TPU_METRICS=1``
+    or ``RAFT_TPU_METRICS_PORT`` set).  Re-read per call, like the
+    ledger's knob, so tests can arm/disarm around individual sweeps."""
+    return bool(obs_config()["metrics"])
+
+
+def _escape_label(v) -> str:
+    return str(v).replace("\\", r"\\").replace('"', r'\"').replace("\n", r"\n")
+
+
+class _Instrument:
+    """Shared instrument core: name/help/labels + a per-instrument lock
+    guarding the ``{label-values-tuple: state}`` table."""
+
+    kind = "untyped"
+
+    def __init__(self, name, help_text, labelnames=()):
+        self.name = name
+        self.help = help_text
+        self.labelnames = tuple(labelnames)
+        self._lock = threading.Lock()
+        self._data: dict = {}
+
+    def _key(self, labels):
+        if set(labels) != set(self.labelnames):
+            raise ValueError(
+                f"{self.name}: got labels {sorted(labels)}, "
+                f"declared {sorted(self.labelnames)}")
+        return tuple(str(labels[n]) for n in self.labelnames)
+
+    def _series(self, key):
+        """Render one ``name{a="b"}`` series head for ``key``."""
+        if not key:
+            return self.name
+        inner = ",".join(f'{n}="{_escape_label(v)}"'
+                         for n, v in zip(self.labelnames, key))
+        return f"{self.name}{{{inner}}}"
+
+    def samples(self):
+        """``[(series_text, value), ...]`` under the instrument lock."""
+        raise NotImplementedError
+
+    def snapshot(self) -> dict:
+        """``{label-tuple-or-(): value-state}`` copy (tests/JSON)."""
+        with self._lock:
+            return dict(self._data)
+
+
+class Counter(_Instrument):
+    """Monotonically increasing labeled counter."""
+
+    kind = "counter"
+
+    def inc(self, value=1, **labels):
+        if value < 0:
+            raise ValueError(f"{self.name}: counters only go up ({value})")
+        key = self._key(labels)
+        with self._lock:
+            self._data[key] = self._data.get(key, 0) + value
+
+    def value(self, **labels) -> float:
+        key = self._key(labels)
+        with self._lock:
+            return self._data.get(key, 0)
+
+    def samples(self):
+        with self._lock:
+            return [(self._series(k), v) for k, v in sorted(self._data.items())]
+
+
+class Gauge(_Instrument):
+    """Labeled gauge: set / inc / dec."""
+
+    kind = "gauge"
+
+    def set(self, value, **labels):
+        key = self._key(labels)
+        with self._lock:
+            self._data[key] = value
+
+    def inc(self, value=1, **labels):
+        key = self._key(labels)
+        with self._lock:
+            self._data[key] = self._data.get(key, 0) + value
+
+    def dec(self, value=1, **labels):
+        self.inc(-value, **labels)
+
+    def value(self, **labels):
+        key = self._key(labels)
+        with self._lock:
+            return self._data.get(key, 0)
+
+    def samples(self):
+        with self._lock:
+            return [(self._series(k), v) for k, v in sorted(self._data.items())]
+
+
+class Histogram(_Instrument):
+    """Fixed-bucket labeled histogram (cumulative Prometheus buckets).
+
+    State per label set: ``[bucket_counts..., +Inf], sum, count``.  The
+    bucket edges are fixed at construction — ``observe`` is a bisect +
+    three adds under the instrument lock, cheap enough for per-chunk
+    call rates.
+    """
+
+    kind = "histogram"
+
+    def __init__(self, name, help_text, buckets, labelnames=()):
+        super().__init__(name, help_text, labelnames)
+        edges = tuple(sorted(float(b) for b in buckets))
+        if not edges:
+            raise ValueError(f"{self.name}: histogram needs >= 1 bucket edge")
+        self.buckets = edges
+
+    def observe(self, value, **labels):
+        key = self._key(labels)
+        value = float(value)
+        with self._lock:
+            state = self._data.get(key)
+            if state is None:
+                state = self._data[key] = [[0] * (len(self.buckets) + 1), 0.0, 0]
+            counts, _, _ = state
+            for i, edge in enumerate(self.buckets):
+                if value <= edge:
+                    counts[i] += 1
+                    break
+            else:
+                counts[-1] += 1
+            state[1] += value
+            state[2] += 1
+
+    def count(self, **labels) -> int:
+        key = self._key(labels)
+        with self._lock:
+            state = self._data.get(key)
+            return state[2] if state else 0
+
+    def samples(self):
+        out = []
+        with self._lock:
+            for key, (counts, total, n) in sorted(self._data.items()):
+                cum = 0
+                for edge, c in zip(self.buckets, counts):
+                    cum += c
+                    le_key = key + (f"{edge:g}",)
+                    names = self.labelnames + ("le",)
+                    inner = ",".join(f'{ln}="{_escape_label(v)}"'
+                                     for ln, v in zip(names, le_key))
+                    out.append((f"{self.name}_bucket{{{inner}}}", cum))
+                inner = ",".join(f'{ln}="{_escape_label(v)}"' for ln, v in zip(
+                    self.labelnames + ("le",), key + ("+Inf",)))
+                out.append((f"{self.name}_bucket{{{inner}}}", cum + counts[-1]))
+                out.append((self._series(key).replace(
+                    self.name, self.name + "_sum", 1), total))
+                out.append((self._series(key).replace(
+                    self.name, self.name + "_count", 1), n))
+        return out
+
+
+class MetricsRegistry:
+    """Name -> instrument table with idempotent get-or-create.
+
+    Re-declaring a name with the same (kind, labels) returns the
+    existing instrument — modules can declare their instruments
+    independently without an init-order protocol; a conflicting
+    re-declaration raises (two meanings for one name is a bug).
+    """
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._instruments: dict = {}
+
+    def _get_or_create(self, cls, name, help_text, labelnames=(), **kw):
+        with self._lock:
+            inst = self._instruments.get(name)
+            if inst is not None:
+                if (type(inst) is not cls
+                        or inst.labelnames != tuple(labelnames)):
+                    raise ValueError(
+                        f"metric {name!r} re-declared as {cls.__name__}"
+                        f"{tuple(labelnames)} but exists as "
+                        f"{type(inst).__name__}{inst.labelnames}")
+                return inst
+            inst = cls(name, help_text, labelnames=labelnames, **kw)
+            self._instruments[name] = inst
+            return inst
+
+    def counter(self, name, help_text, labels=()) -> Counter:
+        return self._get_or_create(Counter, name, help_text, labels)
+
+    def gauge(self, name, help_text, labels=()) -> Gauge:
+        return self._get_or_create(Gauge, name, help_text, labels)
+
+    def histogram(self, name, help_text, buckets, labels=()) -> Histogram:
+        return self._get_or_create(Histogram, name, help_text, labels,
+                                   buckets=buckets)
+
+    def instruments(self):
+        with self._lock:
+            return list(self._instruments.values())
+
+    def render_prometheus(self) -> str:
+        """The registry in Prometheus text exposition format 0.0.4."""
+        lines = []
+        for inst in self.instruments():
+            lines.append(f"# HELP {inst.name} {inst.help}")
+            lines.append(f"# TYPE {inst.name} {inst.kind}")
+            for series, value in inst.samples():
+                lines.append(f"{series} {value}")
+        return "\n".join(lines) + "\n"
+
+    def reset(self):
+        with self._lock:
+            self._instruments.clear()
+
+
+class _NullInstrument:
+    """Telemetry-off instrument: every operation is a cheap no-op."""
+
+    def inc(self, *a, **kw):
+        pass
+
+    def dec(self, *a, **kw):
+        pass
+
+    def set(self, *a, **kw):
+        pass
+
+    def observe(self, *a, **kw):
+        pass
+
+
+_NULL_INSTRUMENT = _NullInstrument()
+
+
+class _NullStd:
+    """Metrics-off stand-in for the standard instrument namespace."""
+
+    def __getattr__(self, name):
+        return _NULL_INSTRUMENT
+
+
+NULL_STD = _NullStd()
+
+# the one process-wide registry (always constructed; emission into it is
+# what enabled() gates, matching the ledger's re-read-per-call knob)
+REGISTRY = MetricsRegistry()
+
+# latency bucket edges (seconds): chunk stages run ms..tens-of-s, XLA
+# compiles run sub-second (exec-cache deserialize) .. minutes
+_STAGE_BUCKETS = (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
+                  5.0, 10.0, 30.0, 60.0)
+_COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
+                    60.0, 120.0, 300.0)
+
+# chunk-loop profiling leaves whose durations become the stage-latency
+# histogram (the full phase name is "sweep/chunks/<stage>" on the main
+# thread, "checkpoint_write" / "compile/<key>" on workers)
+_STAGE_LEAVES = frozenset((
+    "gather", "compute", "fetch", "commit", "isolate",
+    "wait_executable", "checkpoint_write", "resident_upload",
+))
+
+
+class _Std:
+    """The standard raft_tpu instrument set, declared once per process
+    against :data:`REGISTRY`.  Instrument names are the public scrape
+    contract (docs/observability.md)."""
+
+    def __init__(self, reg: MetricsRegistry):
+        c, g, h = reg.counter, reg.gauge, reg.histogram
+        self.runs_started = c(
+            "raft_runs_started_total", "Ledger runs opened", ("kind",))
+        self.runs_finished = c(
+            "raft_runs_finished_total", "Ledger runs finished",
+            ("kind", "ok"))
+        self.run_active = g(
+            "raft_run_active", "1 while a run is active in this process")
+        self.chunks_dispatched = c(
+            "raft_chunks_dispatched_total", "Sweep chunks dispatched")
+        self.chunks_committed = c(
+            "raft_chunks_committed_total", "Sweep chunks committed")
+        self.chunks_in_flight = g(
+            "raft_chunks_in_flight",
+            "Dispatched-not-yet-committed chunk pipeline depth")
+        self.designs_done = g(
+            "raft_sweep_designs_done", "Designs committed in the active run")
+        self.designs_total = g(
+            "raft_sweep_designs_total", "Designs in the active run")
+        self.stage_seconds = h(
+            "raft_chunk_stage_seconds",
+            "Chunk-loop stage latency by profiling phase leaf",
+            _STAGE_BUCKETS, ("stage",))
+        self.compile_queue_depth = g(
+            "raft_compile_queue_depth",
+            "Compile-service tasks submitted and not yet finished")
+        self.compiles_submitted = c(
+            "raft_compiles_submitted_total",
+            "Executable builds handed to the compile service")
+        self.xla_compiles = c(
+            "raft_xla_compiles_total", "Real XLA backend compiles started")
+        self.compile_seconds = h(
+            "raft_compile_seconds",
+            "Executable acquisition seconds by cache level",
+            _COMPILE_BUCKETS, ("cache",))
+        self.exec_cache = c(
+            "raft_exec_cache_total",
+            "Serialized-executable cache lookups by outcome", ("outcome",))
+        self.transfer_bytes = c(
+            "raft_transfer_bytes_total",
+            "Host<->device bytes moved", ("direction",))
+        self.device_bytes_in_use = g(
+            "raft_device_bytes_in_use", "Device memory in use (last probe)")
+        self.device_peak_bytes = g(
+            "raft_device_peak_bytes",
+            "Peak device memory watermark (last probe)")
+        self.quarantine_retries = c(
+            "raft_quarantine_retries_total", "Chunk quarantine retry rounds")
+        self.quarantine_bisects = c(
+            "raft_quarantine_bisects_total", "Chunk quarantine bisect rounds")
+        self.designs_quarantined = c(
+            "raft_designs_quarantined_total", "Designs given up on")
+        self.status_transitions = c(
+            "raft_design_status_total",
+            "Per-design non-ok status transitions", ("to",))
+        self.checkpoint_submits = c(
+            "raft_checkpoint_submits_total",
+            "Checkpoint snapshots submitted to the background writer")
+        self.checkpoint_coalesced = c(
+            "raft_checkpoint_coalesced_total",
+            "Checkpoint snapshots dropped by latest-wins coalescing")
+        self.checkpoint_flushes = c(
+            "raft_checkpoint_flushes_total",
+            "Checkpoint write attempts", ("ok",))
+        self.checkpoint_flush_seconds = h(
+            "raft_checkpoint_flush_seconds", "Checkpoint write latency",
+            _STAGE_BUCKETS)
+        self.warnings = c(
+            "raft_warnings_total", "Warnings routed through obs.log")
+
+
+_STD = None
+_STD_LOCK = threading.Lock()
+
+
+def std():
+    """The standard instrument namespace, or :data:`NULL_STD` when
+    metrics are off.  The hot-seam entry point for the few direct
+    instrumentation sites that have no ledger event (checkpoint
+    coalescing, compile queue depth)."""
+    if not enabled():
+        return NULL_STD
+    global _STD
+    if _STD is None:
+        with _STD_LOCK:
+            if _STD is None:
+                _STD = _Std(REGISTRY)
+    return _STD
+
+
+def registry() -> MetricsRegistry:
+    return REGISTRY
+
+
+def render_prometheus() -> str:
+    return REGISTRY.render_prometheus()
+
+
+# ---------------------------------------------------------------------------
+# live status: the /status + /runs state, maintained from the same
+# event stream that feeds the instruments
+# ---------------------------------------------------------------------------
+
+_STATE_LOCK = threading.Lock()
+_ACTIVE: dict | None = None
+_RECENT: deque = deque(maxlen=32)
+_OBSERVE_ERRORS = 0
+
+
+def status_snapshot() -> dict:
+    """JSON-able live view: the active run (id, lifecycle phase, chunk
+    progress, live ETA straight from the ledger's ``chunk_commit``
+    accounting, health-code tallies) or ``active: null``."""
+    with _STATE_LOCK:
+        active = dict(_ACTIVE) if _ACTIVE is not None else None
+    if active is not None:
+        active["elapsed_s"] = round(time.time() - active["t_start"], 3)
+    return {
+        "time": time.time(),
+        "metrics_enabled": enabled(),
+        "active": active,
+        "runs_recorded": len(_RECENT),
+    }
+
+
+def recent_runs() -> list:
+    """Finished-run summaries, newest first (the /runs payload)."""
+    with _STATE_LOCK:
+        return [dict(r) for r in reversed(_RECENT)]
+
+
+def observe_event(event, rec) -> None:
+    """Map one ledger event onto the live instruments + status state.
+
+    Called from ``Run.emit`` (any emitting thread) AFTER the run lock is
+    released.  Telemetry must never kill the run: mapping errors are
+    counted and logged once, not raised.
+    """
+    try:
+        _observe(event, rec)
+    except Exception:  # noqa: BLE001 - metrics must never break emission
+        global _OBSERVE_ERRORS
+        with _STATE_LOCK:
+            _OBSERVE_ERRORS += 1
+            first = _OBSERVE_ERRORS == 1
+        if first:
+            import logging
+
+            logging.getLogger("raft_tpu.obs.metrics").warning(
+                "metrics observe_event failed for %r", event, exc_info=True)
+
+
+def _observe(event, rec):
+    global _ACTIVE
+    m = std()
+    if m is NULL_STD:
+        return
+    if event == "run_start":
+        m.runs_started.inc(kind=rec.get("kind", "?"))
+        m.run_active.set(1)
+        fp = rec.get("fingerprint") or {}
+        with _STATE_LOCK:
+            _ACTIVE = {
+                "run_id": rec.get("run_id"),
+                "kind": rec.get("kind"),
+                "t_start": rec.get("t", time.time()),
+                "phase": "plan",
+                "last_phase": None,
+                "n_designs": fp.get("n_designs") if isinstance(fp, dict) else None,
+                "n_cases": fp.get("n_cases") if isinstance(fp, dict) else None,
+                "n_chunks": None,
+                "chunk_size": None,
+                "chunks_done": 0,
+                "designs_done": 0,
+                "eta_s": None,
+                "status_counts": {},
+            }
+        if isinstance(fp, dict) and fp.get("n_designs") is not None:
+            m.designs_total.set(int(fp["n_designs"]))
+            m.designs_done.set(0)
+    elif event == "plan":
+        with _STATE_LOCK:
+            if _ACTIVE is not None:
+                _ACTIVE["n_chunks"] = rec.get("n_chunks")
+                _ACTIVE["chunk_size"] = rec.get("chunk_size")
+                _ACTIVE["mode"] = rec.get("mode")
+                _ACTIVE["phase"] = "compile"
+    elif event == "chunk_dispatch":
+        m.chunks_dispatched.inc()
+        m.chunks_in_flight.set(rec.get("in_flight", 0))
+        with _STATE_LOCK:
+            if _ACTIVE is not None:
+                _ACTIVE["phase"] = "chunks"
+    elif event == "chunk_fetch":
+        m.transfer_bytes.inc(rec.get("bytes", 0), direction="d2h")
+    elif event == "chunk_commit":
+        m.chunks_committed.inc()
+        done = rec.get("done", 0)
+        m.designs_done.set(done)
+        with _STATE_LOCK:
+            if _ACTIVE is not None:
+                _ACTIVE["chunks_done"] += 1
+                _ACTIVE["designs_done"] = done
+                _ACTIVE["eta_s"] = rec.get("eta_s")
+    elif event == "phase":
+        name = rec.get("name", "")
+        leaf = name.rsplit("/", 1)[-1]
+        if leaf.startswith("compile"):
+            leaf = "compile"
+        if leaf in _STAGE_LEAVES or leaf == "compile":
+            m.stage_seconds.observe(rec.get("seconds", 0.0), stage=leaf)
+        with _STATE_LOCK:
+            if _ACTIVE is not None:
+                _ACTIVE["last_phase"] = name
+    elif event == "compile_submitted":
+        m.compiles_submitted.inc()
+    elif event == "compile_start":
+        if rec.get("real"):
+            m.xla_compiles.inc()
+    elif event == "compile_end":
+        if rec.get("seconds") is not None:
+            m.compile_seconds.observe(rec["seconds"],
+                                      cache=rec.get("cache", "?"))
+    elif event in ("exec_cache_hit", "exec_cache_miss",
+                   "exec_cache_store", "exec_cache_reject"):
+        m.exec_cache.inc(outcome=event[len("exec_cache_"):])
+    elif event == "transfer":
+        m.transfer_bytes.inc(rec.get("bytes", 0),
+                             direction=rec.get("direction", "?"))
+    elif event == "device_memory":
+        if rec.get("bytes_in_use") is not None:
+            m.device_bytes_in_use.set(rec["bytes_in_use"])
+        if rec.get("peak_bytes") is not None:
+            m.device_peak_bytes.set(rec["peak_bytes"])
+    elif event == "quarantine_retry":
+        m.quarantine_retries.inc()
+    elif event == "quarantine_bisect":
+        m.quarantine_bisects.inc()
+    elif event == "design_quarantined":
+        m.designs_quarantined.inc(len(rec.get("designs") or ()))
+    elif event == "status_transition":
+        to = rec.get("to", "?")
+        n = len(rec.get("designs") or ())
+        m.status_transitions.inc(n, to=to)
+        with _STATE_LOCK:
+            if _ACTIVE is not None:
+                tallies = _ACTIVE["status_counts"]
+                tallies[to] = tallies.get(to, 0) + n
+    elif event == "checkpoint_flush":
+        m.checkpoint_flushes.inc(ok=str(bool(rec.get("ok"))).lower())
+        if rec.get("seconds") is not None:
+            m.checkpoint_flush_seconds.observe(rec["seconds"])
+    elif event == "health_report":
+        with _STATE_LOCK:
+            if _ACTIVE is not None and isinstance(rec.get("counts"), dict):
+                _ACTIVE["health_counts"] = dict(rec["counts"])
+    elif event == "warning":
+        m.warnings.inc()
+    elif event == "run_end":
+        ok = bool(rec.get("ok"))
+        with _STATE_LOCK:
+            active, _ACTIVE = _ACTIVE, None
+            kind = (active or {}).get("kind", "?")
+            summary = {
+                "run_id": (active or {}).get("run_id"),
+                "kind": kind,
+                "ok": ok,
+                "t_start": (active or {}).get("t_start"),
+                "t_end": rec.get("t", time.time()),
+                "n_designs": (active or {}).get("n_designs"),
+                "designs_done": (active or {}).get("designs_done"),
+                "counts": rec.get("counts"),
+                "error": rec.get("error"),
+            }
+            if summary["t_start"] is not None:
+                summary["span_s"] = round(
+                    summary["t_end"] - summary["t_start"], 3)
+            _RECENT.append(summary)
+        m.run_active.set(0)
+        m.chunks_in_flight.set(0)
+        m.runs_finished.inc(kind=kind, ok=str(ok).lower())
+
+
+def reset() -> None:
+    """Clear all instrument data and live state (test isolation)."""
+    global _STD, _ACTIVE, _OBSERVE_ERRORS
+    with _STATE_LOCK:
+        _ACTIVE = None
+        _RECENT.clear()
+        _OBSERVE_ERRORS = 0
+    with _STD_LOCK:
+        _STD = None
+        REGISTRY.reset()
+
+
+def status_json() -> str:
+    return json.dumps(status_snapshot())

@@ -41,6 +41,7 @@ from .. import profiling
 from ..config import compile_config
 from ..obs import ledger as obs_ledger
 from ..obs import log as obs_log
+from ..obs import metrics as obs_metrics
 
 __all__ = [
     "CompileService",
@@ -292,6 +293,9 @@ class CompileService:
         self._run.emit("compile_submitted", key=str(key),
                        background=self._background,
                        exec_cache=bool(self._cache_dir and cache_tag is not None))
+        # submitted-not-yet-done depth has no ledger event pair of its
+        # own (submit/_work straddle threads) — direct gauge
+        obs_metrics.std().compile_queue_depth.inc()
         if self._background:
             worker = threading.Thread(
                 target=self._work, args=(task, lowered, cache_tag, warm_args_fn),
@@ -337,5 +341,6 @@ class CompileService:
             task.source = "error"
             task.result = exc
         finally:
+            obs_metrics.std().compile_queue_depth.dec()
             task.done_at = time.perf_counter()
             task._done.set()

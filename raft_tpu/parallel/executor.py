@@ -44,6 +44,7 @@ import jax
 from .. import profiling
 from ..obs import ledger as obs_ledger
 from ..obs import log as obs_log
+from ..obs import metrics as obs_metrics
 
 __all__ = ["gather_rows", "start_host_fetch", "wait_for_executables",
            "CheckpointWriter"]
@@ -168,8 +169,16 @@ class CheckpointWriter:
         with self._cond:
             if self._closing:
                 raise RuntimeError("CheckpointWriter already closed")
+            coalesced = self._pending is not None
             self._pending = state
             self._cond.notify()
+        # no ledger event exists for a dropped-before-write snapshot (it
+        # never reaches on_write), so the coalescing rate is one of the
+        # two direct metrics instrumentation points
+        m = obs_metrics.std()
+        m.checkpoint_submits.inc()
+        if coalesced:
+            m.checkpoint_coalesced.inc()
 
     def _run(self):
         from .. import profiling

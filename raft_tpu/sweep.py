@@ -292,14 +292,17 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
     event file records the template/compile cache story, per-chunk
     dispatch/fetch/commit with pipeline depth, transfer bytes,
     quarantine activity, and per-phase timings, keyed by a run id and a
-    design-batch fingerprint.  Ledger unset (the default) takes the
+    design-batch fingerprint.  With ``RAFT_TPU_METRICS``/
+    ``RAFT_TPU_METRICS_PORT``, the same events also feed the live
+    metrics registry (:mod:`raft_tpu.obs.metrics`) and its ``/metrics``
+    + ``/status`` endpoint.  Both unset (the default) takes the
     zero-instrumentation path: no events, no listeners, bit-identical
     results and zero additional XLA compiles.
     """
     if devices is not None:
         devices = list(devices)
     run = obs_ledger.NULL_RUN
-    if obs_ledger.enabled():
+    if obs_ledger.observing():
         n_designs = 1
         for _, v in axes:
             n_designs *= len(v)
@@ -359,7 +362,7 @@ def precompile(base_design, axes, sea_states, n_iter=15, device=None,
     if devices is not None:
         devices = list(devices)
     run = obs_ledger.NULL_RUN
-    if obs_ledger.enabled():
+    if obs_ledger.observing():
         n_designs = 1
         for _, v in axes:
             n_designs *= len(v)

@@ -1,0 +1,226 @@
+"""Cross-run history store + perf-regression gate (raft_tpu.obs.history).
+
+Synthetic ledgers (the same event vocabulary real runs emit, with
+controlled timings) drive the ingest -> compare -> check pipeline:
+the gate must fail on an injected regression (inflated chunk times /
+wall clock), pass within tolerance, pass vacuously when no prior run
+matches the fingerprint, and enforce absolute --require constraints
+regardless (the CI exec-cache real_compiles<=0 pin).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+from raft_tpu.obs import history as obs_history
+
+
+def _mk_ledger(path, run_id, *, chunk_s=(1.0, 1.0), wall_s=10.0,
+               real_compiles=0, fingerprint=None, kind="sweep", ok=True):
+    """Write one synthetic (schema-shaped) ledger file."""
+    fingerprint = fingerprint if fingerprint is not None else {
+        "design": "abc", "n_designs": 4, "n_cases": 2}
+    t0 = 1000.0
+    events = [{"t": t0, "seq": 1, "event": "run_start",
+               "run_id": run_id, "kind": kind, "fingerprint": fingerprint}]
+    seq = 2
+
+    def add(event, dt, **fields):
+        nonlocal seq
+        events.append({"t": t0 + dt, "seq": seq, "event": event, **fields})
+        seq += 1
+
+    add("plan", 0.1, mode="resident", n_chunks=len(chunk_s), chunk_size=2)
+    for i in range(real_compiles):
+        add("compile_start", 0.2 + i * 0.01, key=f"part{i}", real=True)
+        add("compile_end", 1.0 + i * 0.01, key=f"part{i}", cache="miss",
+            seconds=0.8)
+    t = 1.5
+    done = 0
+    for c, dur in enumerate(chunk_s):
+        add("chunk_dispatch", t, chunk=c, start=c * 2, stop=c * 2 + 2,
+            n_real=2, in_flight=1)
+        add("chunk_fetch", t + dur * 0.8, chunk=c, bytes=4096)
+        done += 2
+        add("chunk_commit", t + dur, chunk=c, done=done,
+            n_designs=2 * len(chunk_s), eta_s=0.0)
+        t += dur
+    add("phase_stats", wall_s - 0.2, name="sweep/chunks", calls=1,
+        total=round(sum(chunk_s), 6), min=min(chunk_s),
+        mean=sum(chunk_s) / len(chunk_s), max=max(chunk_s))
+    add("run_end", wall_s, ok=ok, counts={"ok": done})
+    with open(path, "w", encoding="utf-8") as fh:
+        for ev in events:
+            fh.write(json.dumps(ev) + "\n")
+    return str(path)
+
+
+def test_summarize_ledger_derives_metrics(tmp_path):
+    p = _mk_ledger(tmp_path / "a.jsonl", "run-a", chunk_s=(1.0, 2.0),
+                   wall_s=12.0, real_compiles=2)
+    rec = obs_history.summarize_ledger(p)
+    assert rec["run_id"] == "run-a" and rec["kind"] == "sweep"
+    assert rec["ok"] is True and rec["fp_key"]
+    m = rec["metrics"]
+    assert m["wall_s"] == pytest.approx(12.0)
+    assert m["real_compiles"] == 2
+    assert m["chunks_committed"] == 2
+    assert m["chunk_mean_s"] == pytest.approx(1.5)
+    assert m["chunk_max_s"] == pytest.approx(2.0)
+    assert m["compile_total_s"] == pytest.approx(1.6)
+    assert m["d2h_bytes"] == 8192
+    assert rec["chunk_seconds"] == [pytest.approx(1.0), pytest.approx(2.0)]
+    assert rec["phase_totals"]["sweep/chunks"] == pytest.approx(3.0)
+
+
+def test_ingest_is_append_only_and_deduplicated(tmp_path):
+    store = str(tmp_path / "history.jsonl")
+    a = _mk_ledger(tmp_path / "a.jsonl", "run-a")
+    b = _mk_ledger(tmp_path / "b.jsonl", "run-b")
+    assert obs_history.ingest_paths(store, [a, b]) == 2
+    # re-ingest: nothing new (dedup on run_id)
+    assert obs_history.ingest_paths(store, [a, b]) == 0
+    records = obs_history.load_store(store)
+    assert [r["run_id"] for r in records] == ["run-a", "run-b"]
+    # a directory of ledgers ingests too
+    (tmp_path / "more").mkdir()
+    _mk_ledger(tmp_path / "more" / "c.jsonl", "run-c")
+    assert obs_history.ingest_paths(store, [str(tmp_path / "more")]) == 1
+
+
+def test_ingest_bench_history_jsonl(tmp_path):
+    store = str(tmp_path / "history.jsonl")
+    bench = tmp_path / "bench_history.jsonl"
+    line = {"metric": "1000-design sweep", "value": 30.2, "unit": "s",
+            "t": 1234.5,
+            "detail": {"repeat_sweep_s": 3.1, "repeat_xla_compiles": 0,
+                       "repeat_phases_s": {"chunks": 2.9}}}
+    bench.write_text(json.dumps(line) + "\n" + json.dumps(line) + "\n")
+    assert obs_history.ingest_paths(store, [str(bench)]) >= 1
+    rec = obs_history.load_store(store)[0]
+    assert rec["source"] == "bench" and rec["kind"] == "bench"
+    assert rec["metrics"]["wall_s"] == pytest.approx(30.2)
+    assert rec["metrics"]["real_compiles"] == 0
+    assert rec["phase_totals"]["chunks"] == pytest.approx(2.9)
+
+
+def test_compare_reports_metric_phase_chunk_deltas(tmp_path):
+    old = obs_history.summarize_ledger(
+        _mk_ledger(tmp_path / "a.jsonl", "run-a", chunk_s=(1.0, 1.0),
+                   wall_s=10.0))
+    new = obs_history.summarize_ledger(
+        _mk_ledger(tmp_path / "b.jsonl", "run-b", chunk_s=(1.5, 2.5),
+                   wall_s=14.0))
+    cmp = obs_history.compare_records(old, new)
+    assert cmp["metrics"]["wall_s"]["delta"] == pytest.approx(4.0)
+    assert cmp["metrics"]["wall_s"]["ratio"] == pytest.approx(1.4)
+    assert cmp["phases"]["sweep/chunks"]["delta"] == pytest.approx(2.0)
+    assert cmp["chunks"]["n_compared"] == 2
+    assert cmp["chunks"]["per_chunk_delta_s"] == [
+        pytest.approx(0.5), pytest.approx(1.5)]
+    assert cmp["chunks"]["max_delta_s"] == pytest.approx(1.5)
+
+
+def _store_with(tmp_path, specs):
+    """Ingest a sequence of synthetic ledgers; return the store path."""
+    store = str(tmp_path / "history.jsonl")
+    paths = []
+    for i, kw in enumerate(specs):
+        paths.append(_mk_ledger(tmp_path / f"r{i}.jsonl", f"run-{i}", **kw))
+    assert obs_history.ingest_paths(store, paths) == len(specs)
+    return store
+
+
+def test_check_fails_on_injected_regression(tmp_path):
+    """ISSUE acceptance: nonzero exit on a synthetic ledger with
+    inflated chunk times vs the rolling baseline."""
+    store = _store_with(tmp_path, [
+        {"chunk_s": (1.0, 1.0), "wall_s": 10.0},
+        {"chunk_s": (1.1, 0.9), "wall_s": 10.2},
+        {"chunk_s": (2.5, 2.5), "wall_s": 21.0},  # newest: 2x regression
+    ])
+    rc = obs_history.main(["check", "--store", store, "--tolerance", "0.25"])
+    assert rc == 1
+    result = obs_history.run_check(obs_history.load_store(store),
+                                   tolerance=0.25)
+    assert not result["ok"]
+    failed = {c["metric"] for c in result["checks"] if not c["ok"]}
+    assert {"wall_s", "chunk_mean_s"} <= failed
+    assert len(result["baseline_runs"]) == 2
+
+
+def test_check_passes_within_tolerance(tmp_path):
+    store = _store_with(tmp_path, [
+        {"chunk_s": (1.0, 1.0), "wall_s": 10.0},
+        {"chunk_s": (1.05, 1.05), "wall_s": 10.8},  # +8% < 25% tolerance
+    ])
+    assert obs_history.main(["check", "--store", store]) == 0
+
+
+def test_check_passes_with_no_matching_fingerprint(tmp_path):
+    """A new workload has no baseline: the relative gate is vacuous
+    (exit 0), it must not compare apples to oranges."""
+    store = _store_with(tmp_path, [
+        {"fingerprint": {"design": "aaa", "n_designs": 4}},
+        {"fingerprint": {"design": "bbb", "n_designs": 1000},
+         "chunk_s": (9.0, 9.0), "wall_s": 99.0},
+    ])
+    rc = obs_history.main(["check", "--store", store])
+    assert rc == 0
+    result = obs_history.run_check(obs_history.load_store(store))
+    assert result["ok"] and result["baseline_runs"] == []
+    assert any("no prior record matches" in n for n in result["notes"])
+
+
+def test_check_requires_are_absolute(tmp_path):
+    """--require constraints bind even without a baseline (the CI
+    exec-cache pin: the warm run must show zero real compiles)."""
+    store = _store_with(tmp_path, [{"real_compiles": 2}])
+    assert obs_history.main(
+        ["check", "--store", store, "--require", "real_compiles<=0"]) == 1
+    assert obs_history.main(
+        ["check", "--store", store, "--require", "real_compiles<=2"]) == 0
+    # malformed expressions are a usage error, not a silent pass
+    with pytest.raises(ValueError):
+        obs_history.parse_require("real_compiles !! 0")
+
+
+def test_check_empty_store_is_clean(tmp_path):
+    store = str(tmp_path / "empty.jsonl")
+    assert obs_history.main(["check", "--store", store]) == 0
+
+
+def test_list_and_compare_cli(tmp_path, capsys):
+    store = _store_with(tmp_path, [
+        {"chunk_s": (1.0, 1.0)}, {"chunk_s": (1.2, 1.2)}])
+    assert obs_history.main(["list", "--store", store]) == 0
+    out = capsys.readouterr().out
+    assert "run-0" in out and "run-1" in out and "wall_s" in out
+    assert obs_history.main(["compare", "--store", store]) == 0
+    out = capsys.readouterr().out
+    assert "run-0" in out and "run-1" in out and "chunks" in out
+    # explicit pair by run-id prefix, JSON output
+    assert obs_history.main(
+        ["compare", "--store", store, "run-0", "run-1", "--json"]) == 0
+    cmp = json.loads(capsys.readouterr().out)
+    assert cmp["old_run"] == "run-0" and cmp["new_run"] == "run-1"
+
+
+@pytest.mark.slow
+def test_cli_exit_code_through_real_process(tmp_path):
+    """The gate's exit code must survive the real `python -m` boundary
+    (what CI shells out to)."""
+    store = _store_with(tmp_path, [
+        {"chunk_s": (1.0, 1.0), "wall_s": 10.0},
+        {"chunk_s": (3.0, 3.0), "wall_s": 25.0},
+    ])
+    env = dict(os.environ, JAX_PLATFORMS="cpu")
+    proc = subprocess.run(
+        [sys.executable, "-m", "raft_tpu.obs.history", "check",
+         "--store", store],
+        capture_output=True, text=True, env=env, timeout=120)
+    assert proc.returncode == 1, proc.stdout + proc.stderr
+    assert "FAIL" in proc.stdout

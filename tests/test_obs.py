@@ -11,6 +11,9 @@ capture the fault/quarantine narrative when a chunk dies.
 
 import json
 import logging
+import threading
+import urllib.error
+import urllib.request
 
 import numpy as np
 import pytest
@@ -18,7 +21,9 @@ import pytest
 from raft_tpu import sweep as sweep_mod
 from raft_tpu.designs import demo_spar
 from raft_tpu.obs import ledger as obs_ledger
+from raft_tpu.obs import live as obs_live
 from raft_tpu.obs import log as obs_log
+from raft_tpu.obs import metrics as obs_metrics
 from raft_tpu.obs import report as obs_report
 from raft_tpu.obs import schema as obs_schema
 from raft_tpu.robust import STATUS_OK, STATUS_QUARANTINED
@@ -366,3 +371,254 @@ def test_display_funnel_prints(capsys):
     logger = obs_log.get_logger("test.display")
     obs_log.display(logger, "progress line")
     assert "progress line" in capsys.readouterr().out
+
+
+# ---------------------------------------------------------------------------
+# live metrics registry (obs.metrics) + endpoint (obs.live)
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def metrics_env(monkeypatch):
+    """Arm the registry for one test and restore pristine global state."""
+    monkeypatch.delenv("RAFT_TPU_LEDGER", raising=False)
+    monkeypatch.setenv("RAFT_TPU_METRICS", "1")
+    obs_metrics.reset()
+    yield obs_metrics
+    obs_live.stop_server()
+    obs_metrics.reset()
+
+
+def test_metrics_instruments_and_prometheus_render(metrics_env):
+    reg = obs_metrics.MetricsRegistry()
+    c = reg.counter("t_total", "a counter", ("kind",))
+    c.inc(kind="a")
+    c.inc(2, kind="b")
+    assert c.value(kind="b") == 2
+    with pytest.raises(ValueError):
+        c.inc(-1, kind="a")  # counters only go up
+    with pytest.raises(ValueError):
+        c.inc(kind="a", extra="nope")  # undeclared label
+    g = reg.gauge("t_depth", "a gauge")
+    g.set(3)
+    g.dec()
+    assert g.value() == 2
+    h = reg.histogram("t_lat", "a histogram", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.1)   # == edge lands IN the 0.1 bucket (le semantics)
+    h.observe(5.0)   # overflow -> +Inf only
+    assert h.count() == 3
+    # idempotent re-declare; conflicting re-declare raises
+    assert reg.counter("t_total", "a counter", ("kind",)) is c
+    with pytest.raises(ValueError):
+        reg.gauge("t_total", "now a gauge")
+
+    text = reg.render_prometheus()
+    assert "# TYPE t_total counter" in text
+    assert 't_total{kind="b"} 2' in text
+    assert "# TYPE t_lat histogram" in text
+    assert 't_lat_bucket{le="0.1"} 2' in text
+    assert 't_lat_bucket{le="1"} 2' in text
+    assert 't_lat_bucket{le="+Inf"} 3' in text
+    assert "t_lat_count 3" in text
+    assert "t_lat_sum 5.15" in text
+
+
+def test_observe_event_drives_live_status(metrics_env):
+    """A synthetic event stream (same vocabulary a real run emits) must
+    populate the instruments and the /status /runs state."""
+    obs_metrics.observe_event("run_start", {
+        "t": 1.0, "run_id": "r1", "kind": "sweep",
+        "fingerprint": {"n_designs": 4, "n_cases": 2}})
+    obs_metrics.observe_event("plan", {"n_chunks": 2, "chunk_size": 2,
+                                       "mode": "resident"})
+    obs_metrics.observe_event("chunk_dispatch", {"chunk": 0, "in_flight": 1})
+    obs_metrics.observe_event("phase", {"name": "sweep/chunks/compute",
+                                        "seconds": 0.02})
+    obs_metrics.observe_event("exec_cache_hit", {"key": "partA"})
+    obs_metrics.observe_event("chunk_commit", {"chunk": 0, "done": 2,
+                                               "n_designs": 4, "eta_s": 0.5})
+    obs_metrics.observe_event("status_transition",
+                              {"designs": [3], "to": "non_converged"})
+
+    st = obs_metrics.status_snapshot()["active"]
+    assert st["run_id"] == "r1" and st["phase"] == "chunks"
+    assert st["n_designs"] == 4 and st["n_chunks"] == 2
+    assert st["chunks_done"] == 1 and st["designs_done"] == 2
+    assert st["eta_s"] == 0.5
+    assert st["status_counts"] == {"non_converged": 1}
+
+    m = obs_metrics.std()
+    assert m.chunks_dispatched.value() == 1
+    assert m.chunks_committed.value() == 1
+    assert m.stage_seconds.count(stage="compute") == 1
+    assert m.exec_cache.value(outcome="hit") == 1
+
+    obs_metrics.observe_event("run_end", {"t": 9.0, "ok": True,
+                                          "counts": {"ok": 4}})
+    assert obs_metrics.status_snapshot()["active"] is None
+    runs = obs_metrics.recent_runs()
+    assert runs[0]["run_id"] == "r1" and runs[0]["ok"] is True
+    assert m.runs_finished.value(kind="sweep", ok="true") == 1
+    assert m.chunks_in_flight.value() == 0
+
+
+def test_metrics_only_run_is_fileless_and_feeds_registry(metrics_env):
+    """Ledger off + metrics on: start_run hands out a file-less Run so
+    the single emission point feeds the registry without touching disk."""
+    assert obs_ledger.observing()
+    run = obs_ledger.start_run("sweep", fingerprint={"n_designs": 4})
+    assert run.enabled and run.path is None
+    run.emit("chunk_dispatch", chunk=0, start=0, stop=2, n_real=2,
+             in_flight=1)
+    run.finish(ok=True)
+    m = obs_metrics.std()
+    assert m.chunks_dispatched.value() == 1
+    assert obs_metrics.recent_runs()[0]["kind"] == "sweep"
+    # both consumers off -> NULL_RUN (zero-overhead path intact)
+    import os
+
+    os.environ.pop("RAFT_TPU_METRICS", None)
+    obs_live.stop_server()
+    assert not obs_ledger.observing()
+    assert obs_ledger.start_run("sweep") is obs_ledger.NULL_RUN
+    os.environ["RAFT_TPU_METRICS"] = "1"
+
+
+@pytest.mark.sentinel
+def test_metrics_on_off_bit_identical_no_recompile(monkeypatch):
+    """ISSUE acceptance: sweeps with metrics armed are bit-identical to
+    metrics-off sweeps and compile ZERO additional XLA programs — the
+    registry never touches jit/lowering."""
+    from raft_tpu.analysis.recompile import RecompileSentinel
+
+    monkeypatch.delenv("RAFT_TPU_LEDGER", raising=False)
+    monkeypatch.delenv("RAFT_TPU_METRICS", raising=False)
+    base = _sweep()  # warm: compiles + memoizes the executables
+
+    obs_metrics.reset()
+    try:
+        with RecompileSentinel() as s:
+            snap = s.snapshot()
+            off = _sweep()
+            s.assert_no_recompile(snap, "metrics-off sweep")
+            monkeypatch.setenv("RAFT_TPU_METRICS", "1")
+            on = _sweep()
+            s.assert_no_recompile(snap, "metrics-on sweep")
+
+        for a, b in ((base, off), (off, on)):
+            np.testing.assert_array_equal(a["motion_std"], b["motion_std"])
+            np.testing.assert_array_equal(a["AxRNA_std"], b["AxRNA_std"])
+            np.testing.assert_array_equal(a["status"], b["status"])
+        # the armed sweep actually fed the registry
+        m = obs_metrics.std()
+        assert m.chunks_committed.value() == 2
+        assert m.stage_seconds.count(stage="compute") >= 2
+        assert obs_metrics.recent_runs()[0]["ok"] is True
+        monkeypatch.delenv("RAFT_TPU_METRICS")
+    finally:
+        obs_metrics.reset()
+
+
+def test_live_endpoint_scrapes_mid_sweep(metrics_env, monkeypatch):
+    """ISSUE acceptance: with RAFT_TPU_METRICS_PORT set, /metrics and
+    /status answer from another thread WHILE a sweep runs."""
+    _sweep()  # warm so the threaded sweep takes the fast memoized path
+    monkeypatch.setenv("RAFT_TPU_METRICS_PORT", "0")  # ephemeral bind
+    obs_metrics.reset()
+
+    paused, release = threading.Event(), threading.Event()
+
+    def hook(idx, dispatch):
+        if (np.asarray(idx) == 1).any():
+            paused.set()
+            assert release.wait(30), "scraper never released the sweep"
+        return dispatch(idx)
+
+    monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", hook)
+    box = {}
+
+    def run_sweep():
+        try:
+            box["out"] = _sweep()
+        except BaseException as e:  # noqa: BLE001 - surfaced after join
+            box["err"] = e
+
+    t = threading.Thread(target=run_sweep, daemon=True)
+    t.start()
+    try:
+        assert paused.wait(60), "sweep never reached the paused chunk"
+        host, port = obs_live.server_address()
+        base = f"http://{host}:{port}"
+
+        with urllib.request.urlopen(f"{base}/metrics", timeout=10) as r:
+            assert r.status == 200
+            text = r.read().decode()
+        assert "# TYPE raft_chunk_stage_seconds histogram" in text
+        assert "# TYPE raft_exec_cache_total counter" in text
+        assert "raft_chunks_dispatched_total" in text
+        assert "raft_run_active 1" in text
+
+        with urllib.request.urlopen(f"{base}/status", timeout=10) as r:
+            status = json.loads(r.read().decode())
+        active = status["active"]
+        assert active is not None and active["kind"] == "sweep"
+        assert active["phase"] == "chunks"
+        assert active["n_designs"] == 4
+        assert "eta_s" in active  # live ETA slot (set at first commit)
+    finally:
+        release.set()
+        t.join(timeout=120)
+        monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", None)
+
+    assert "err" not in box, box.get("err")
+    assert (box["out"]["status"] == STATUS_OK).all()
+
+    # after the run: /status idles, /runs remembers it
+    host, port = obs_live.server_address()
+    with urllib.request.urlopen(f"http://{host}:{port}/status",
+                                timeout=10) as r:
+        assert json.loads(r.read().decode())["active"] is None
+    with urllib.request.urlopen(f"http://{host}:{port}/runs",
+                                timeout=10) as r:
+        runs = json.loads(r.read().decode())["runs"]
+    assert runs and runs[0]["kind"] == "sweep" and runs[0]["ok"] is True
+
+
+def test_live_endpoint_404(metrics_env, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_METRICS_PORT", "0")
+    srv = obs_live.ensure_server()
+    assert srv is not None
+    try:
+        urllib.request.urlopen(f"{srv.url}/nope", timeout=10)
+        assert False, "expected HTTP 404"
+    except urllib.error.HTTPError as e:
+        assert e.code == 404
+
+
+def test_device_memory_reports_supported_flag(tmp_path, monkeypatch):
+    """Satellite fix: a backend without memory_stats() yields
+    supported=false (distinguishing 'zero bytes' from 'not measured')
+    plus a one-time warning, never an error."""
+    monkeypatch.setenv("RAFT_TPU_LEDGER", str(tmp_path))
+    run = obs_ledger.start_run("test")
+
+    class NoStats:
+        def memory_stats(self):
+            return None
+
+        def __str__(self):
+            return "FakeCpu:0"
+
+    obs_ledger.emit_device_memory(run, device=NoStats(), what="t1")
+    obs_ledger.emit_device_memory(run, device=NoStats(), what="t2")
+    run.finish(ok=True)
+    events = obs_ledger.read_events(run.path)
+    mems = [e for e in events if e["event"] == "device_memory"]
+    assert len(mems) == 2
+    assert all(m["supported"] is False for m in mems)
+    assert all(m["bytes_in_use"] is None for m in mems)
+    # warn_once: exactly one warning despite two probes of the device
+    warns = [e for e in events if e["event"] == "warning"
+             and "memory_stats" in e["message"]]
+    assert len(warns) == 1
