@@ -20,6 +20,7 @@ device runtime of the 1000x12 solve is <1 s on one chip).
 
 import json
 import os
+import sys
 import tempfile
 import time
 
@@ -28,6 +29,11 @@ import numpy as np
 
 def main():
     import jax
+
+    # --mesh: run the benchmarked sweeps over every attached device (the
+    # production (design, case) mesh) instead of one chip; the result
+    # line then also stamps the mesh shape and per-device throughput
+    mesh_mode = "--mesh" in sys.argv[1:]
 
     # arm the run ledger so every benchmarked sweep leaves an auditable
     # event log; honour a caller-provided RAFT_TPU_LEDGER destination
@@ -79,10 +85,16 @@ def main():
 
     # host-side template/parse work runs pinned to CPU (tiny kernels);
     # the stacked variant batch and both big XLA programs run on `accel`
+    # --mesh shards the sweep over every addressable accelerator (the
+    # sweep auto-sizes the design axis to the workload); default is the
+    # single-chip BASELINE configuration
+    target = ({"devices": jax.devices()} if mesh_mode
+              else {"device": accel})
+
     with jax.default_device(cpu):
         t0 = time.perf_counter()
-        out = sweep(design, axes, states, n_iter=15, device=accel, wind=wind,
-                    chunk_size=250)
+        out = sweep(design, axes, states, n_iter=15, wind=wind,
+                    chunk_size=250, **target)
         dt = time.perf_counter() - t0
         assert np.all(np.isfinite(out["motion_std"])), "sweep produced non-finite metrics"
 
@@ -99,8 +111,8 @@ def main():
         # sweep: the warm path must be compile-free (executor acceptance
         # gate) — any nonzero count here is cache-key churn
         with RecompileSentinel() as sentinel:
-            out2 = sweep(design, axes, states, n_iter=15, device=accel,
-                         wind=wind, chunk_size=250)
+            out2 = sweep(design, axes, states, n_iter=15, wind=wind,
+                         chunk_size=250, **target)
         dt_warm = time.perf_counter() - t0
         phases = profiling.report()
         chunks_s = phases.get("sweep/chunks", float("nan"))
@@ -146,6 +158,7 @@ def main():
 
     runs = obs_ledger.list_runs(ledger_dir)
     ledger_detail = {"dir": ledger_dir, "runs": len(runs)}
+    mesh_detail = None
     if runs:
         events = obs_ledger.read_events(runs[-1])
         counts: dict = {}
@@ -158,6 +171,21 @@ def main():
             "schema_errors": obs_schema.validate_events(events),
             "event_counts": counts,
         })
+        if mesh_mode:
+            # mesh attribution from the warm run's plan event: the shape
+            # the sweep actually built (it auto-sizes the design axis to
+            # the workload) and per-device throughput for the scaling
+            # trajectory in bench_history.jsonl
+            plan = next((ev for ev in events if ev.get("event") == "plan"),
+                        {})
+            n_used = len(plan.get("devices") or []) or 1
+            mesh_detail = {
+                "shape": plan.get("mesh"),
+                "n_devices": n_used,
+                "chunk_size_global": plan.get("chunk_size"),
+                "designs_per_sec_per_device":
+                    round(n_designs / dt_warm / n_used, 1),
+            }
 
     # cold-start anatomy from the FIRST run's ledger (the cold sweep):
     # per-executable compile (or exec-cache deserialize) seconds, the
@@ -223,6 +251,9 @@ def main():
             # run-ledger audit of the benchmarked sweeps (schema_errors
             # must be []); render with `python -m raft_tpu.obs.report`
             "ledger": ledger_detail,
+            # --mesh only: mesh shape + per-device throughput (null on
+            # the single-chip BASELINE run)
+            "mesh": mesh_detail,
         },
     }
     print(json.dumps(result))

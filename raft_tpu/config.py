@@ -98,6 +98,93 @@ def executor_config(overrides=None) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# production (design, case) mesh selection (raft_tpu.sweep)
+# ---------------------------------------------------------------------------
+
+# The production sweep always executes through ONE mesh-sharded code
+# path (jax.sharding.Mesh over ('design', 'case') axes); the device set
+# it shards over comes from, in priority order, the explicit
+# ``sweep(devices=...)`` argument, the RAFT_TPU_MESH environment
+# variable, and finally the single default device — the degenerate 1x1
+# mesh, which is the SAME code with one shard, not a separate branch.
+# RAFT_TPU_MESH values:
+#
+#   (unset/"")   single device (1x1 mesh); ``sweep(device=...)`` picks it
+#   "all"/"auto" every visible device (jax.devices())
+#   "<n>"        the first n devices
+#   "<D>x<C>"    explicit (design, case) mesh shape over the first D*C
+#                devices; C must divide the sweep's sea-state count
+#
+# Without an explicit shape the case extent is gcd(n_devices, n_cases)
+# and the remaining devices shard the design axis (the big axis of a
+# DOE sweep).  See docs/performance.md, "Scaling out".
+
+
+def mesh_spec():
+    """Parsed RAFT_TPU_MESH: ``None`` (unset -> single device),
+    ``("all",)``, ``("count", n)`` or ``("shape", d, c)``."""
+    import os
+    import re
+
+    raw = os.environ.get("RAFT_TPU_MESH", "").strip().lower()
+    if not raw:
+        return None
+    if raw in ("all", "auto"):
+        return ("all",)
+    m = re.fullmatch(r"(\d+)x(\d+)", raw)
+    if m:
+        d, c = int(m.group(1)), int(m.group(2))
+        if d < 1 or c < 1:
+            raise ValueError(f"RAFT_TPU_MESH={raw!r}: mesh axes must be >= 1")
+        return ("shape", d, c)
+    if raw.isdigit():
+        n = int(raw)
+        if n < 1:
+            raise ValueError(f"RAFT_TPU_MESH={raw!r}: device count must be >= 1")
+        return ("count", n)
+    raise ValueError(
+        f"RAFT_TPU_MESH={raw!r}: expected 'all', a device count, or 'DxC'")
+
+
+def resolve_mesh_devices(devices=None, device=None):
+    """The device list the sweep's (design, case) mesh spans, plus the
+    explicit mesh shape when RAFT_TPU_MESH pinned one.
+
+    Returns ``(devices, shape_or_None)``.  ``devices`` (the explicit
+    ``sweep(devices=...)`` argument) wins over the environment; with
+    neither, the fallback is the single device ``device`` (or the
+    process default) — the 1x1 degenerate mesh.
+    """
+    if devices is not None:
+        devices = list(devices)
+        if not devices:
+            raise ValueError("devices must be a non-empty sequence")
+        return devices, None
+    spec = mesh_spec()
+    if spec is None:
+        if device is None:
+            device = getattr(jax.config, "jax_default_device", None)
+        if device is None:
+            device = jax.devices()[0]
+        return [device], None
+    all_devices = jax.devices()
+    if spec[0] == "all":
+        return list(all_devices), None
+    if spec[0] == "count":
+        n = spec[1]
+        if n > len(all_devices):
+            raise ValueError(
+                f"RAFT_TPU_MESH={n}: only {len(all_devices)} device(s) visible")
+        return list(all_devices[:n]), None
+    d, c = spec[1], spec[2]
+    if d * c > len(all_devices):
+        raise ValueError(
+            f"RAFT_TPU_MESH={d}x{c}: needs {d * c} devices, only "
+            f"{len(all_devices)} visible")
+    return list(all_devices[:d * c]), (d, c)
+
+
+# ---------------------------------------------------------------------------
 # background compile pipeline / serialized-executable cache
 # (raft_tpu.parallel.compile_service)
 # ---------------------------------------------------------------------------

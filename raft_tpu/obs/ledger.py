@@ -277,8 +277,17 @@ def emit_device_memory(run, device=None, what=""):
     is recorded with ``supported=false`` (so dashboards can distinguish
     "zero bytes" from "not measured") plus a one-time warning, never an
     error.
+
+    ``device`` may be a list of devices (the sweep's mesh): each is
+    probed independently and emits its own ``device_memory`` event, so
+    the per-device gauges in :mod:`raft_tpu.obs.metrics` see one series
+    per mesh member.
     """
     if not run.enabled:
+        return
+    if isinstance(device, (list, tuple)):
+        for d in device:
+            emit_device_memory(run, device=d, what=what)
         return
     bytes_in_use = peak = err = None
     supported = False
@@ -317,6 +326,28 @@ def tree_nbytes(tree) -> int:
 
     return int(sum(getattr(leaf, "nbytes", 0)
                    for leaf in jax.tree_util.tree_leaves(tree)))
+
+
+def shard_bytes(tree):
+    """Per-device byte split of a pytree of (possibly sharded) jax
+    arrays: ``{str(device.id): bytes}`` over every addressable shard.
+
+    Host/numpy leaves (no ``addressable_shards``) are skipped — this
+    measures what actually lives on (or moves per-) device.  Feeds the
+    ``per_device`` field of ``transfer``/``chunk_fetch`` events, which
+    the metrics registry splits into device-labeled counter series.
+    """
+    import jax
+
+    out = {}
+    for leaf in jax.tree_util.tree_leaves(tree):
+        shards = getattr(leaf, "addressable_shards", None)
+        if not shards:
+            continue
+        for sh in shards:
+            key = str(sh.device.id)
+            out[key] = out.get(key, 0) + int(getattr(sh.data, "nbytes", 0))
+    return out
 
 
 def list_runs(ledger_dir):

@@ -360,14 +360,18 @@ class _Std:
         self.exec_cache = c(
             "raft_exec_cache_total",
             "Serialized-executable cache lookups by outcome", ("outcome",))
+        # "device" is the jax device id ("0", "1", ...) when the emitter
+        # attributed the bytes per mesh member, "all" when it could only
+        # account the aggregate (host-side packs, single-device runs)
         self.transfer_bytes = c(
             "raft_transfer_bytes_total",
-            "Host<->device bytes moved", ("direction",))
+            "Host<->device bytes moved", ("direction", "device"))
         self.device_bytes_in_use = g(
-            "raft_device_bytes_in_use", "Device memory in use (last probe)")
+            "raft_device_bytes_in_use", "Device memory in use (last probe)",
+            ("device",))
         self.device_peak_bytes = g(
             "raft_device_peak_bytes",
-            "Peak device memory watermark (last probe)")
+            "Peak device memory watermark (last probe)", ("device",))
         self.quarantine_retries = c(
             "raft_quarantine_retries_total", "Chunk quarantine retry rounds")
         self.quarantine_bisects = c(
@@ -474,6 +478,20 @@ def observe_event(event, rec) -> None:
                 "metrics observe_event failed for %r", event, exc_info=True)
 
 
+def _inc_transfer(m, rec, direction):
+    """Transfer-byte accounting, per-device when the event carries a
+    ``per_device`` split (``{device_id: bytes}`` from
+    :func:`raft_tpu.obs.ledger.shard_bytes`), aggregate under
+    ``device="all"`` otherwise."""
+    per_device = rec.get("per_device")
+    if isinstance(per_device, dict) and per_device:
+        for dev, b in per_device.items():
+            m.transfer_bytes.inc(b, direction=direction, device=str(dev))
+    else:
+        m.transfer_bytes.inc(rec.get("bytes", 0), direction=direction,
+                             device="all")
+
+
 def _observe(event, rec):
     global _ACTIVE
     m = std()
@@ -498,6 +516,7 @@ def _observe(event, rec):
                 "designs_done": 0,
                 "eta_s": None,
                 "status_counts": {},
+                "per_device_in_flight": {},
             }
         if isinstance(fp, dict) and fp.get("n_designs") is not None:
             m.designs_total.set(int(fp["n_designs"]))
@@ -511,12 +530,19 @@ def _observe(event, rec):
                 _ACTIVE["phase"] = "compile"
     elif event == "chunk_dispatch":
         m.chunks_dispatched.inc()
-        m.chunks_in_flight.set(rec.get("in_flight", 0))
+        in_flight = rec.get("in_flight", 0)
+        m.chunks_in_flight.set(in_flight)
         with _STATE_LOCK:
             if _ACTIVE is not None:
                 _ACTIVE["phase"] = "chunks"
+                # every mesh member executes its shard of every chunk,
+                # so each device's in-flight depth IS the pipeline depth
+                devices = rec.get("devices")
+                if devices:
+                    _ACTIVE["per_device_in_flight"] = {
+                        str(d): in_flight for d in devices}
     elif event == "chunk_fetch":
-        m.transfer_bytes.inc(rec.get("bytes", 0), direction="d2h")
+        _inc_transfer(m, rec, "d2h")
     elif event == "chunk_commit":
         m.chunks_committed.inc()
         done = rec.get("done", 0)
@@ -549,13 +575,13 @@ def _observe(event, rec):
                    "exec_cache_store", "exec_cache_reject"):
         m.exec_cache.inc(outcome=event[len("exec_cache_"):])
     elif event == "transfer":
-        m.transfer_bytes.inc(rec.get("bytes", 0),
-                             direction=rec.get("direction", "?"))
+        _inc_transfer(m, rec, rec.get("direction", "?"))
     elif event == "device_memory":
+        dev = str(rec.get("device") or "?")
         if rec.get("bytes_in_use") is not None:
-            m.device_bytes_in_use.set(rec["bytes_in_use"])
+            m.device_bytes_in_use.set(rec["bytes_in_use"], device=dev)
         if rec.get("peak_bytes") is not None:
-            m.device_peak_bytes.set(rec["peak_bytes"])
+            m.device_peak_bytes.set(rec["peak_bytes"], device=dev)
     elif event == "quarantine_retry":
         m.quarantine_retries.inc()
     elif event == "quarantine_bisect":

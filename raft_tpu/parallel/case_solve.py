@@ -132,7 +132,7 @@ def design_params(fowt, include_aero=True, device=None):
         if obs_ledger.current_run().enabled:
             obs_ledger.emit("transfer", direction="h2d",
                             bytes=obs_ledger.tree_nbytes(params),
-                            what="design_params")
+                            what="design_params", device=str(device))
         params = jax.device_put(params, device)
         params["nodes"].update(flags)
     return params, {"mcf": mcf, "nw": fowt.nw, "depth": fowt.depth,

@@ -14,8 +14,9 @@ XLA work that releases the GIL.  This module exploits that twice:
   not the whole compile — the cold-start cost.
 * A serialized-executable cache (``RAFT_TPU_EXEC_CACHE``, via
   ``jax.experimental.serialize_executable``): a fresh compile is
-  serialized to disk keyed by (backend, platform, executable key,
-  ``jit_key`` tag, StableHLO program hash), and a later process
+  serialized to disk keyed by (backend, platform, device topology,
+  executable key, ``jit_key`` tag, StableHLO program hash), and a later
+  process
   deserializes it instead of recompiling — zero real XLA compiles on a
   warm cache.  Any mismatch (jax/jaxlib version, backend, corrupt or
   truncated entry) is REJECTED with an ``exec_cache_reject`` ledger
@@ -59,7 +60,10 @@ _LOG = obs_log.get_logger("parallel.compile_service")
 _COMPILE_HOOK = None
 
 # Bump when the on-disk entry layout changes; older entries are rejected.
-_ENTRY_VERSION = 1
+# v2: device topology (device count + kinds) joined the meta/path
+# fingerprint — a cache populated on a 1-device host must never serve a
+# (mesh-shaped, topology-pinned) executable to an 8-device mesh.
+_ENTRY_VERSION = 2
 
 # Marker file recording which backend first populated a cache directory;
 # lets a process on a DIFFERENT backend warn instead of silently missing
@@ -83,6 +87,19 @@ def _backend_fingerprint():
     return jax.default_backend(), str(getattr(dev, "device_kind", "unknown"))
 
 
+def _topology_fingerprint() -> str:
+    """Device topology the executable is pinned to: visible device count
+    plus the sorted set of device kinds.  A mesh-sharded Compiled object
+    is built FOR a device set — deserializing a 1-device entry onto an
+    8-device mesh (or vice versa) is at best a crash, at worst silent
+    wrong placement — so topology is part of both the entry meta and the
+    path fingerprint."""
+    devices = jax.devices()
+    kinds = sorted({str(getattr(d, "device_kind", "unknown"))
+                    for d in devices})
+    return f"{len(devices)}:{','.join(kinds)}"
+
+
 def _entry_meta(key, tag, phash) -> dict:
     import jaxlib
 
@@ -93,6 +110,7 @@ def _entry_meta(key, tag, phash) -> dict:
         "jaxlib": getattr(jaxlib, "__version__", "unknown"),
         "backend": backend,
         "platform": kind,
+        "topology": _topology_fingerprint(),
         "key": str(key),
         "tag": str(tag),
         "program": phash,
@@ -101,7 +119,8 @@ def _entry_meta(key, tag, phash) -> dict:
 
 def _entry_path(cache_dir, key, tag, phash) -> str:
     h = hashlib.sha256()
-    for part in (*_backend_fingerprint(), str(key), str(tag), phash):
+    for part in (*_backend_fingerprint(), _topology_fingerprint(),
+                 str(key), str(tag), phash):
         h.update(part.encode())
         h.update(b"\0")
     return os.path.join(cache_dir, f"{h.hexdigest()[:32]}.jexec")
@@ -128,7 +147,8 @@ def _load_entry(path, key, run):
     try:
         meta = entry["meta"]
         expect = _entry_meta(key, meta.get("tag", ""), meta.get("program", ""))
-        for field in ("entry_version", "jax", "jaxlib", "backend", "platform"):
+        for field in ("entry_version", "jax", "jaxlib", "backend", "platform",
+                      "topology"):
             if meta.get(field) != expect[field]:
                 reason = (f"{field} mismatch (entry {meta.get(field)!r}, "
                           f"running {expect[field]!r})")

@@ -46,8 +46,8 @@ from ..obs import ledger as obs_ledger
 from ..obs import log as obs_log
 from ..obs import metrics as obs_metrics
 
-__all__ = ["gather_rows", "start_host_fetch", "wait_for_executables",
-           "CheckpointWriter"]
+__all__ = ["gather_rows", "chunk_selector", "start_host_fetch",
+           "wait_for_executables", "CheckpointWriter", "FaultIsolator"]
 
 _LOG = obs_log.get_logger("parallel.executor")
 
@@ -102,6 +102,107 @@ def gather_rows(resident, idx):
     Returns the packed [chunk, width] buffers the chunk executable
     consumes — freshly materialized, so the caller may donate them."""
     return [r[idx] for r in resident]
+
+
+# jitted per-output-sharding chunk selectors, memoized for the process
+# lifetime: a fresh jax.jit wrapper per sweep would be a fresh trace
+# cache, i.e. one real XLA compile per sweep — fatal to the warm
+# zero-recompile contract.  NamedSharding hashes by (mesh, spec), so
+# repeat sweeps on the same topology share one entry.
+_CHUNK_SELECT_CACHE: dict = {}
+
+
+def chunk_selector(sharding):
+    """The mesh-era :func:`gather_rows`: a jitted selector pulling chunk
+    ``k`` out of a chunk-major resident batch.
+
+    ``resident`` is a list of [n_chunks, chunk_size, width] per-dtype
+    buffers laid out ``P(None, "design")`` on the (design, case) mesh —
+    every chunk's rows already live on the shard that will compute them,
+    so selecting chunk ``k`` (``dynamic_index_in_dim`` with a traced
+    scalar, ONE compile for all k) is shard-local: no collectives, no
+    host copy, no H2D.  Outputs carry ``sharding`` (the chunk
+    executables' design-sharded input layout) and are freshly
+    materialized, so the caller may donate them.
+    """
+    jitted = _CHUNK_SELECT_CACHE.get(sharding)
+    if jitted is None:
+        def select(resident, k):
+            return [jax.lax.dynamic_index_in_dim(r, k, axis=0,
+                                                 keepdims=False)
+                    for r in resident]
+
+        jitted = jax.jit(select, out_shardings=sharding)
+        _CHUNK_SELECT_CACHE[sharding] = jitted
+    return jitted
+
+
+class FaultIsolator:
+    """Off-thread quarantine so one shard's fault never stalls the rest.
+
+    When a chunk raises, retry-then-bisect isolation
+    (:func:`raft_tpu.robust.quarantine.run_isolated`) re-executes pieces
+    of the chunk synchronously — on the dispatching thread that work
+    would block the pipeline loop, serializing every healthy in-flight
+    chunk on the other shards behind one shard's fault.  The sweep
+    instead submits the isolation body here and keeps dispatching; the
+    single worker thread preserves isolation order (bisection results
+    commit in submission order, matching the single-threaded semantics).
+
+    The submitter emits the fault's ledger events/warnings *before*
+    ``submit`` so ledger ordering and ``pytest.warns`` stay
+    deterministic.  ``drain()`` joins all queued work and re-raises the
+    first unexpected isolation error on the caller's thread — the sweep
+    calls it before committing final state, so failures cannot be
+    silently dropped.  The worker thread is started lazily: healthy
+    sweeps never pay for it.
+    """
+
+    def __init__(self, name="raft-fault-isolator"):
+        self._name = name
+        self._cond = threading.Condition()
+        self._queue = []
+        self._closing = False
+        self._error = None
+        self._thread = None
+
+    def submit(self, fn) -> None:
+        """Queue isolation body ``fn`` (no args) for the worker."""
+        with self._cond:
+            if self._closing:
+                raise RuntimeError("FaultIsolator already drained")
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._run, name=self._name, daemon=True)
+                self._thread.start()
+            self._queue.append(fn)
+            self._cond.notify()
+
+    def _run(self):
+        while True:
+            with self._cond:
+                while not self._queue and not self._closing:
+                    self._cond.wait()
+                if not self._queue:  # closing, all drained
+                    return
+                fn = self._queue.pop(0)
+            try:
+                fn()
+            except Exception as e:  # noqa: BLE001 - re-raised at drain()
+                with self._cond:
+                    if self._error is None:
+                        self._error = e
+
+    def drain(self) -> None:
+        """Join all queued isolation work; re-raise its first error."""
+        with self._cond:
+            self._closing = True
+            self._cond.notify_all()
+            thread = self._thread
+        if thread is not None:
+            thread.join()
+        if self._error is not None:
+            raise self._error
 
 
 def start_host_fetch(tree):

@@ -52,7 +52,7 @@ def _merge(parts, idx_parts, n_rows):
     return out
 
 
-def run_isolated(run, idx, retries=1, display=0):
+def run_isolated(run, idx, retries=1, display=0, align=1):
     """Execute ``run(idx)`` with fault isolation.
 
     Parameters
@@ -73,6 +73,12 @@ def run_isolated(run, idx, retries=1, display=0):
         (transient device faults).  Bisection halves run with
         ``retries=0`` — one retry per originally-failing chunk, so a
         hard-failing chunk costs O(log n) extra executions, not O(n).
+    align : int
+        Round bisection split points down to a multiple of ``align``
+        while the sub-chunk is still larger than it (the sweep passes
+        its mesh's design-axis extent, so each half's real rows occupy
+        whole shard rows of the padded chunk executables).  ``align=1``
+        (the default) is the exact historical plain bisection.
 
     Returns
     -------
@@ -88,10 +94,11 @@ def run_isolated(run, idx, retries=1, display=0):
     from .. import profiling
 
     with profiling.phase("isolate"):
-        return _run_isolated(run, idx, retries=retries, display=display)
+        return _run_isolated(run, idx, retries=retries, display=display,
+                             align=align)
 
 
-def _run_isolated(run, idx, retries=1, display=0, _depth=0):
+def _run_isolated(run, idx, retries=1, display=0, align=1, _depth=0):
     idx = np.asarray(idx)
     n = len(idx)
     last_err = None
@@ -121,11 +128,14 @@ def _run_isolated(run, idx, retries=1, display=0, _depth=0):
             _LOG, f"sweep: chunk of {n} design(s) still failing "
                   f"({type(last_err).__name__}); bisecting to isolate")
     mid = n // 2
+    if align > 1 and n > align:
+        # snap to the shard tiling; clamped so both halves stay non-empty
+        mid = max(align, (mid // align) * align)
     halves = [idx[:mid], idx[mid:]]
     parts, masks = [], []
     for half in halves:
         res, mask = _run_isolated(run, half, retries=0, display=display,
-                                  _depth=_depth + 1)
+                                  align=align, _depth=_depth + 1)
         parts.append(res)
         masks.append(mask)
     quarantined = np.concatenate(masks)

@@ -23,6 +23,7 @@ grid of metrics.
 from __future__ import annotations
 
 import copy
+import functools
 import itertools
 import warnings
 import zipfile
@@ -33,7 +34,7 @@ import jax.numpy as jnp
 
 from . import profiling
 from .analysis.contracts import shape_contract
-from .config import executor_config, health_config
+from .config import executor_config, health_config, resolve_mesh_devices
 from .core.model import Model
 from .obs import ledger as obs_ledger
 from .obs import log as obs_log
@@ -43,8 +44,9 @@ from .parallel.design_batch import (SweepAxisError, pack_rows, pack_spec,
                                     set_in_design, stack_variants,
                                     unpack_leaves, variant_finite_mask)
 from .parallel.compile_service import CompileService
-from .parallel.executor import (CheckpointWriter, gather_rows,
-                                start_host_fetch, wait_for_executables)
+from .parallel.executor import (CheckpointWriter, FaultIsolator,
+                                chunk_selector, start_host_fetch,
+                                wait_for_executables)
 from .robust import (STATUS_NAN, STATUS_OK, STATUS_QUARANTINED, SolveHealth,
                      build_report, classify_health, format_report,
                      run_isolated)
@@ -93,20 +95,41 @@ def _template_key(base_design, n_iter, with_aero):
     return (_design_hash(base_design), int(n_iter), bool(with_aero))
 
 
-def _design_case_mesh(devices, n_cases):
+def _design_case_mesh(devices, n_cases, shape=None):
     """Factor ``devices`` into the production (design, case) mesh.
 
-    The case extent is gcd(n_devices, n_cases) so the sea-state batch
-    always divides evenly over the 'case' axis (no padding); remaining
-    devices shard the design axis — the big axis in a DOE sweep.
-    """
-    import math
+    The default factorization puts EVERY device on the 'design' axis —
+    the big axis in a DOE sweep — and keeps the case axis at 1.  That
+    choice is what makes the mesh result bit-identical to the
+    single-device run: each shard's local program then sees the full
+    sea-state batch (``n_cases``) and the requested per-shard chunk of
+    designs, i.e. exactly the shapes the 1x1 mesh compiles, and XLA:CPU
+    codegen is batch-extent-sensitive in its last bits.  One device is
+    the degenerate 1x1 mesh — the production sweep runs the SAME
+    sharded code path at every scale.
 
+    ``shape`` (from ``RAFT_TPU_MESH="DxC"``) pins the factorization
+    instead; its case extent must then divide ``n_cases``.  A case
+    extent > 1 shrinks each shard's local sea-state batch, so results
+    agree with single-device to floating-point tolerance (~1 ulp)
+    rather than bitwise — useful when designs are scarce and sea
+    states plentiful, opt-in by construction.
+    """
     from jax.sharding import Mesh
 
     n_dev = len(devices)
-    n_case_ax = math.gcd(n_dev, n_cases)
-    n_design_ax = n_dev // n_case_ax
+    if shape is not None:
+        n_design_ax, n_case_ax = shape
+        if n_design_ax * n_case_ax != n_dev:
+            raise ValueError(
+                f"mesh shape {n_design_ax}x{n_case_ax} does not use the "
+                f"{n_dev} selected device(s)")
+        if n_cases % n_case_ax:
+            raise ValueError(
+                f"mesh case axis {n_case_ax} does not divide the "
+                f"{n_cases} sea state(s); pick a case extent that does")
+    else:
+        n_design_ax, n_case_ax = n_dev, 1
     return Mesh(np.asarray(devices).reshape(n_design_ax, n_case_ax),
                 ("design", "case"))
 
@@ -234,8 +257,11 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         'case' axis of a 2-D device mesh (the north-star sharding:
         "parametersweep shards design variants over the pod",
         BASELINE.json; reference loop raft/parametersweep.py:56-100).
-        One entry (or ``None``) keeps the single-device path — then
-        ``device`` selects the chip.
+        The sweep ALWAYS runs this mesh path: ``None`` consults
+        ``RAFT_TPU_MESH`` (:func:`raft_tpu.config.resolve_mesh_devices`)
+        and otherwise falls back to the single device picked by
+        ``device`` — the degenerate 1x1 mesh of the same code, not a
+        separate branch; results are bit-identical at every mesh shape.
     wind : list of case dicts, optional
         One reference-style case dict per sea state (wind_speed,
         turbulence, ...).  Turns the aero-servo impedance ON: the rotor
@@ -299,8 +325,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
     zero-instrumentation path: no events, no listeners, bit-identical
     results and zero additional XLA compiles.
     """
-    if devices is not None:
-        devices = list(devices)
+    devices, mesh_shape = resolve_mesh_devices(devices, device)
     run = obs_ledger.NULL_RUN
     if obs_ledger.observing():
         n_designs = 1
@@ -314,12 +339,13 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                          "n_cases": len(sea_states)},
             meta={"n_iter": int(n_iter), "chunk_size": int(chunk_size),
                   "wind": wind is not None,
-                  "n_devices": len(devices) if devices is not None else 1})
+                  "n_devices": len(devices)})
     try:
         out = _sweep_impl(base_design, axes, sea_states, n_iter=n_iter,
                           device=device, display=display,
                           checkpoint=checkpoint, chunk_size=chunk_size,
-                          wind=wind, devices=devices, health=health, run=run)
+                          wind=wind, devices=devices, mesh_shape=mesh_shape,
+                          health=health, run=run)
         run.finish(ok=True, counts=out["report"]["counts"])
         return out
     except BaseException as e:
@@ -359,8 +385,7 @@ def precompile(base_design, axes, sea_states, n_iter=15, device=None,
     (``'compile'`` | ``'exec_cache'``) and ``seconds``, and ``cache``
     (``'memo'`` when the executables were already memoized in-process).
     """
-    if devices is not None:
-        devices = list(devices)
+    devices, mesh_shape = resolve_mesh_devices(devices, device)
     run = obs_ledger.NULL_RUN
     if obs_ledger.observing():
         n_designs = 1
@@ -374,12 +399,13 @@ def precompile(base_design, axes, sea_states, n_iter=15, device=None,
                          "n_cases": len(sea_states)},
             meta={"n_iter": int(n_iter), "chunk_size": int(chunk_size),
                   "wind": wind is not None,
-                  "n_devices": len(devices) if devices is not None else 1})
+                  "n_devices": len(devices)})
     try:
         out = _sweep_impl(base_design, axes, sea_states, n_iter=n_iter,
                           device=device, display=display, checkpoint=None,
                           chunk_size=chunk_size, wind=wind, devices=devices,
-                          health=health, run=run, compile_only=True)
+                          mesh_shape=mesh_shape, health=health, run=run,
+                          compile_only=True)
         run.finish(ok=True)
         return out
     except BaseException as e:
@@ -391,7 +417,7 @@ def precompile(base_design, axes, sea_states, n_iter=15, device=None,
 
 def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 checkpoint, chunk_size, wind, devices, health, run,
-                compile_only=False):
+                mesh_shape=None, compile_only=False):
     """:func:`sweep` body; ``run`` is the active ledger run (NULL_RUN
     when telemetry is off — every ``run.emit`` is then a no-op and all
     byte/stat collection is gated behind ``run.enabled``).
@@ -418,15 +444,34 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         hcfg = health_config(dict(health))
     run_health = bool(hcfg["enabled"])
 
-    mesh = None
-    if devices is not None:
-        devices = list(devices)
-        if len(devices) == 1:
-            device, devices = devices[0], None
-        else:
-            mesh = _design_case_mesh(devices, n_cases)
-            n_design_ax = mesh.devices.shape[0]
-            mesh_sig = (mesh.devices.shape, tuple(str(d) for d in devices))
+    # the production path is ALWAYS the (design, case) mesh — a single
+    # device is the degenerate 1x1 mesh of the same sharded code, not a
+    # separate branch (callers resolve the device set via
+    # config.resolve_mesh_devices; RAFT_TPU_MESH scales it out)
+    devices = list(devices if devices is not None
+                   else resolve_mesh_devices(None, device)[0])
+    # the per-shard design extent IS the single-device chunk extent:
+    # every shard's local program compiles exactly the shapes the 1x1
+    # mesh compiles (the bit-identity contract).  Fixed before the mesh
+    # is built so the design axis can be sized to the workload: shards
+    # beyond ceil(n_designs / chunk_local) would only ever hold padding
+    # rows, so they are dropped rather than silently burning memory
+    chunk_local = max(1, min(int(chunk_size), n_designs))
+    if mesh_shape is None and len(devices) > 1:
+        n_useful = -(-n_designs // chunk_local)
+        if n_useful < len(devices):
+            if display:
+                obs_log.display(
+                    _LOG,
+                    f"sweep: mesh design axis sized to workload — using "
+                    f"{n_useful} of {len(devices)} device(s) "
+                    f"({n_designs} designs / chunk {chunk_local})")
+            devices = devices[:n_useful]
+    mesh = _design_case_mesh(devices, n_cases, shape=mesh_shape)
+    n_design_ax = mesh.devices.shape[0]
+    mesh_sig = (mesh.devices.shape, tuple(str(d) for d in devices))
+    if device is None:
+        device = devices[0]  # per-variant fallback path placement
 
     def _fresh_state():
         return (np.full((n_designs, n_cases, 6), np.nan),
@@ -602,19 +647,21 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         mode = ("sel_wind" if aero_axes and wind is not None
                 else "sel" if aero_axes
                 else "aero" if wind is not None else "plain")
-        chunk_size = min(chunk_size, n_designs)
-        if mesh is not None:
-            # every chunk must tile the 'design' mesh axis exactly
-            chunk_size = max(n_design_ax,
-                             (chunk_size // n_design_ax) * n_design_ax)
+        # a GLOBAL chunk is n_design_ax consecutive single-device-shaped
+        # chunks, one per shard: each shard's local program compiles the
+        # exact shapes of the 1x1 mesh — the property the bit-identity
+        # contract rests on (XLA codegen differs in the last bits
+        # between batch extents) — and the chunk phase scales by
+        # dispatching n_design_ax single-device chunks per step.  On
+        # the 1x1 mesh this is a no-op (chunk_local computed above).
+        chunk_size = chunk_local * n_design_ax
         # the chunk executables are AOT-compiled against exact argument
         # shapes and shardings, so the memo keys them by everything that
-        # shapes the programs: mode, the device/mesh placement (a Compiled
+        # shapes the programs: mode, the mesh placement (a Compiled
         # object is pinned to it — unlike jit it cannot transparently
-        # recompile for a different device), chunk/case/variant extents —
-        # and checks treedef+spec (the packed transfer layout)
-        place_sig = (mesh_sig if mesh is not None
-                     else str(device) if device is not None else None)
+        # recompile for a different device set), chunk/case/variant
+        # extents — and checks treedef+spec (the packed transfer layout)
+        place_sig = mesh_sig
         # the health channel changes the traced programs (extra outputs,
         # residual-carrying scan, Tikhonov constants), so it is part of
         # the executable identity
@@ -626,7 +673,9 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         pipeline_depth = max(1, int(ecfg["pipeline_depth"]))
         run.emit("plan", mode=mode, n_chunks=-(-n_designs // chunk_size),
                  chunk_size=chunk_size, pipeline_depth=pipeline_depth,
-                 resident=bool(ecfg["resident"] and mesh is None))
+                 resident=bool(ecfg["resident"]),
+                 mesh=[int(s) for s in mesh.devices.shape],
+                 devices=[int(d.id) for d in devices])
         if (memo is not None and memo["treedef"] == treedef
                 and memo.get("spec") == spec):
             jitted = memo["jitted"].get(jit_key)
@@ -638,18 +687,15 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             run.emit("compile_cache", cache="hit")
         from jax.sharding import NamedSharding, PartitionSpec as P
 
-        if mesh is not None:
-            put_d = lambda x: jax.device_put(x, NamedSharding(mesh, P("design")))
-            put_c = lambda x: jax.device_put(x, NamedSharding(mesh, P("case")))
-            # small per-turbine-variant tables: replicate; the per-chunk
-            # gather index is design-sharded, so the gathered arrays land
-            # design-sharded without collectives
-            put_r = lambda x: jax.device_put(x, NamedSharding(mesh, P()))
-        elif device is not None:
-            put_d = put_c = put_r = lambda x: jax.device_put(x, device)
-        else:
-            put_d = put_c = put_r = (
-                lambda x: jax.tree_util.tree_map(jnp.asarray, x))
+        d_sh = NamedSharding(mesh, P("design"))
+        c_sh = NamedSharding(mesh, P("case"))
+        # small per-turbine-variant tables: replicate; the per-chunk
+        # gather index is design-sharded, so the gathered arrays land
+        # design-sharded without collectives
+        r_sh = NamedSharding(mesh, P())
+        put_d = lambda x: jax.device_put(x, d_sh)
+        put_c = lambda x: jax.device_put(x, c_sh)
+        put_r = lambda x: jax.device_put(x, r_sh)
         # commit the shared per-case inputs once (uncommitted arrays would
         # re-transfer to the accelerator on every chunk call)
         zetas = put_c(zetas)
@@ -760,42 +806,55 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                                    in_axes=(0, None, None, 0))(params, zetas, betas, aero_v)
                     return _postB(out, sel["zh"][av])
 
-            if mesh is None:
-                # donate the per-chunk intermediates: argument 0 of A is
-                # the gathered/packed chunk buffers (produced fresh per
-                # chunk by the on-device gather or the host pack) and
-                # argument 0 of B is A's params output — neither is read
-                # again after the call, so XLA reuses their device memory
-                # for outputs instead of allocating a second chunk's
-                # worth.  The shared inputs (zetas/betas/variant tables/
-                # resident batch) are NOT in argnum 0 and stay intact.
-                # Mesh path: no donation — keep the sharded programs'
-                # buffer story simple.
-                jA = jax.jit(partA, donate_argnums=(0,))
-                jB = jax.jit(partB, donate_argnums=(0,))
-                sds = ((lambda sh, dt: jax.ShapeDtypeStruct(sh, dt))
-                       if device is None else
-                       (lambda sh, dt, _s=jax.sharding.SingleDeviceSharding(device):
-                        jax.ShapeDtypeStruct(sh, dt, sharding=_s)))
+            # donate the per-chunk intermediates: argument 0 of A is
+            # the gathered/packed chunk buffers (produced fresh per
+            # chunk by the on-device gather or the host pack) and
+            # argument 0 of B is A's params output — neither is read
+            # again after the call, so XLA reuses their device memory
+            # for outputs instead of allocating a second chunk's
+            # worth.  The shared inputs (zetas/betas/variant tables/
+            # resident batch) are NOT in argnum 0 and stay intact.
+            # Donation composes with the explicit shardings: a donated
+            # input is aliased only to an output of matching layout,
+            # per shard.
+            # shard_map, not bare GSPMD: letting the partitioner rewrite
+            # the global HLO perturbs CPU codegen enough to move the last
+            # bits (~1e-15 on the demo spar), breaking the bit-identity
+            # contract with the single-device run.  Under shard_map each
+            # shard compiles the SAME local program as the 1x1 mesh —
+            # only the batch extent shrinks, which is bit-invariant here
+            # (all reductions are within-design/within-case) — so the
+            # mesh result is bit-identical to single-device.
+            from jax.experimental.shard_map import shard_map
+
+            dc = NamedSharding(mesh, P("design", "case"))
+            pd, pc, pr, pdc = P("design"), P("case"), P(), P("design", "case")
+            if mode in ("sel", "sel_wind"):
+                inA = ([d_sh] * len(spec), r_sh, d_sh)
+                inB = (d_sh, c_sh, c_sh, r_sh, d_sh)
+                specA = ([pd] * len(spec), pr, pd)
+                specB = (pd, pc, pc, pr, pd)
             else:
-                d_sh = NamedSharding(mesh, P("design"))
-                r_sh = NamedSharding(mesh, P())
-                c_sh = NamedSharding(mesh, P("case"))
-                dc = NamedSharding(mesh, P("design", "case"))
-                if mode in ("sel", "sel_wind"):
-                    inA = ([d_sh] * len(spec), r_sh, d_sh)
-                    inB = (d_sh, c_sh, c_sh, r_sh, d_sh)
-                else:
-                    inA = ([d_sh] * len(spec),)
-                    inB = ((d_sh, c_sh, c_sh) if mode == "plain"
-                           else (d_sh, c_sh, c_sh, c_sh))
-                jA = jax.jit(partA, in_shardings=inA, out_shardings=(d_sh, d_sh))
-                # the health pytree's leaves are [chunk, ncase] like the
-                # metrics, so the same (design, case) sharding applies as
-                # a pytree prefix
-                outB_sh = (dc, dc, dc) if run_health else (dc, dc)
-                jB = jax.jit(partB, in_shardings=inB, out_shardings=outB_sh)
-                sds = lambda sh, dt: jax.ShapeDtypeStruct(sh, dt)
+                inA = ([d_sh] * len(spec),)
+                inB = ((d_sh, c_sh, c_sh) if mode == "plain"
+                       else (d_sh, c_sh, c_sh, c_sh))
+                specA = ([pd] * len(spec),)
+                specB = ((pd, pc, pc) if mode == "plain"
+                         else (pd, pc, pc, pc))
+            shA = shard_map(partA, mesh=mesh, in_specs=specA,
+                            out_specs=(pd, pd), check_rep=False)
+            jA = jax.jit(shA, donate_argnums=(0,),
+                         in_shardings=inA, out_shardings=(d_sh, d_sh))
+            # the health pytree's leaves are [chunk, ncase] like the
+            # metrics, so the same (design, case) sharding applies as
+            # a pytree prefix
+            outB_spec = (pdc, pdc, pdc) if run_health else (pdc, pdc)
+            outB_sh = (dc, dc, dc) if run_health else (dc, dc)
+            shB = shard_map(partB, mesh=mesh, in_specs=specB,
+                            out_specs=outB_spec, check_rep=False)
+            jB = jax.jit(shB, donate_argnums=(0,),
+                         in_shardings=inB, out_shardings=outB_sh)
+            sds = lambda sh, dt: jax.ShapeDtypeStruct(sh, dt)
 
             fdt = np.dtype(zetas.dtype)
             nw = static["nw"]
@@ -1036,17 +1095,21 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         input_ok = variant_finite_mask(stacked)
 
         # ---- device-resident executor state (parallel/executor.py).
-        # The whole packed variant batch is uploaded ONCE and each chunk
-        # is selected on-device by the jitted gather, replacing a
-        # per-chunk host fancy-index copy + H2D transfer with one fused
-        # device gather.  Cached in the template memo (keyed like the
-        # stack memo plus device placement), so a repeat sweep re-uploads
-        # nothing.  Disabled on the mesh path: a design-sharded gather by
-        # arbitrary global indices would need collectives, and the mesh
-        # path's per-chunk transfers are already split across chips.
+        # The whole packed variant batch is uploaded ONCE, chunk-major:
+        # [n_chunks, chunk_size, width] buffers laid out P(None,
+        # "design") on the mesh, so every chunk's rows already live on
+        # the shard that will compute them and per-chunk selection
+        # (executor.chunk_selector, a dynamic slice by a traced chunk
+        # number) is shard-local — no collectives, no host copy, no H2D.
+        # A design-sharded flat batch gathered by arbitrary global
+        # indices would instead make GSPMD insert all-to-alls per chunk.
+        # Cached in the template memo (keyed like the stack memo plus
+        # mesh placement and chunk tiling), so a repeat sweep re-uploads
+        # nothing.
         resident = None
-        if ecfg["resident"] and mesh is None:
-            rkey = (stack_key, place_sig) if stack_key is not None else None
+        if ecfg["resident"]:
+            rkey = ((stack_key, place_sig, chunk_size)
+                    if stack_key is not None else None)
             entry = _TEMPLATE_MEMO.get(memo_key)
             rcache = None
             if (rkey is not None and entry is not None
@@ -1056,13 +1119,27 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 resident = rcache.get(rkey)
             if resident is None:
                 with profiling.phase("sweep/resident_upload"):
-                    resident = [put_d(b) for b in
-                                pack_rows(stacked, spec, np.arange(n_designs))]
+                    n_chunks_r = -(-n_designs // chunk_size)
+                    chunk_idx = np.empty((n_chunks_r, chunk_size),
+                                         dtype=np.int64)
+                    for k in range(n_chunks_r):
+                        c_start = k * chunk_size
+                        c_stop = min(c_start + chunk_size, n_designs)
+                        # identical padding rule to the chunk loop below
+                        row = np.arange(c_start, c_start + chunk_size)
+                        row[c_stop - c_start:] = c_stop - 1
+                        chunk_idx[k] = row
+                    cm_sh = NamedSharding(mesh, P(None, "design"))
+                    resident = [jax.device_put(b[chunk_idx], cm_sh)
+                                for b in pack_rows(stacked, spec,
+                                                   np.arange(n_designs))]
                 if run.enabled:
+                    per_dev = obs_ledger.shard_bytes(resident)
                     run.emit("transfer", direction="h2d",
                              bytes=obs_ledger.tree_nbytes(resident),
-                             what="resident_batch")
-                    obs_ledger.emit_device_memory(run, device=device,
+                             what="resident_batch",
+                             **({"per_device": per_dev} if per_dev else {}))
+                    obs_ledger.emit_device_memory(run, device=devices,
                                                   what="resident_upload")
                 if rcache is not None:
                     while len(rcache) >= 2:
@@ -1077,7 +1154,9 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         ckpt_writer = None
         if checkpoint:
             ckpt_writer = CheckpointWriter(
-                lambda st: _save_checkpoint(checkpoint, sig, *st),
+                lambda st: _save_checkpoint(
+                    checkpoint, sig, *st,
+                    mesh_shape=tuple(mesh.devices.shape)),
                 on_write=(lambda secs, err: run.emit(
                     "checkpoint_flush", seconds=secs, ok=err is None))
                 if run.enabled else None)
@@ -1110,24 +1189,33 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             # execution order per design are unchanged).
             pending = []
 
-            def _dispatch(idx):
+            def _dispatch(idx, chunk_no=None):
                 """Queue one padded chunk; returns un-fetched device
-                results (std, a_std, props, health-or-None)."""
-                dispatch = _dispatch_real
+                results (std, a_std, props, health-or-None).
+                ``chunk_no`` selects the pre-staged resident chunk;
+                ``None`` (quarantine re-execution, RAFT_TPU_RESIDENT=0)
+                host-packs ``idx`` instead."""
+                dispatch = functools.partial(_dispatch_real,
+                                             chunk_no=chunk_no)
                 if _CHUNK_EXEC_HOOK is not None:
                     return _CHUNK_EXEC_HOOK(np.asarray(idx), dispatch)
                 return dispatch(idx)
 
-            def _dispatch_real(idx):
+            def _dispatch_real(idx, chunk_no=None):
                 with profiling.phase("gather"):
-                    if resident is not None:
-                        # on-device chunk selection from the resident
-                        # batch (fresh output buffers -> donatable to A)
-                        packed = gather_rows(
-                            resident, put_d(np.asarray(idx, dtype=np.int32)))
+                    if resident is not None and chunk_no is not None:
+                        # shard-local chunk selection from the
+                        # chunk-major resident batch (fresh output
+                        # buffers -> donatable to A); the traced-scalar
+                        # chunk number keeps it ONE compile for all
+                        # chunks, and the process-wide selector memo
+                        # keeps warm repeat sweeps at zero compiles
+                        packed = chunk_selector(d_sh)(
+                            resident, np.int32(chunk_no))
                     else:
-                        # legacy path (RAFT_TPU_RESIDENT=0 / mesh): host
-                        # fancy-index pack + per-chunk transfer
+                        # host fancy-index pack + per-chunk transfer;
+                        # device_put commits exactly the executables'
+                        # design sharding, so no new XLA programs
                         packed = [put_d(b) for b in pack_rows(stacked, spec, idx)]
                 with profiling.phase("compute"):
                     if mode == "plain":
@@ -1210,8 +1298,13 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                           + sum(v.nbytes for v in pr_rows.values())
                           + (sum(v.nbytes for v in hb_rows.values())
                              if hb_rows is not None else 0))
+                    # per-shard split of the device-side result buffers:
+                    # each mesh member streamed its shard back
+                    # independently (copy_to_host_async is per-shard)
+                    per_dev = obs_ledger.shard_bytes((std, a_std, pr, hb))
                     run.emit("chunk_fetch", chunk=start // chunk_size,
-                             bytes=int(nb))
+                             bytes=int(nb),
+                             **({"per_device": per_dev} if per_dev else {}))
                 with profiling.phase("commit"):
                     _store_rows(np.arange(start, stop), std_rows, a_std_rows,
                                 pr_rows, hb_rows)
@@ -1245,10 +1338,17 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                         rows[k] = np.asarray(v)[:n_r]
                 return rows
 
+            isolator = FaultIsolator()
+
             def _isolate(start, stop, err):
-                """A chunk raised (dispatch or fetch): re-run it through
-                the retry-then-bisect runner so only the poison designs
-                are lost."""
+                """A chunk raised (dispatch or fetch): emit the fault
+                synchronously (deterministic ledger/warning order), then
+                hand the retry-then-bisect re-execution to the isolation
+                worker — the main loop keeps dispatching, so one shard's
+                fault never stalls the other shards' pipelines.  The
+                single worker preserves the single-threaded isolation
+                semantics (faulted chunks isolate in submission order);
+                its errors re-raise at ``drain()`` below."""
                 run.emit("chunk_fault", start=start, stop=stop,
                          error=f"{type(err).__name__}: {err}")
                 obs_log.warn(
@@ -1256,9 +1356,19 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                     f"sweep: chunk {start}-{stop} raised "
                     f"({type(err).__name__}: {err}); isolating faults",
                     RuntimeWarning)
+                isolator.submit(functools.partial(_isolate_body, start, stop))
+
+            def _isolate_body(start, stop):
                 rows_idx = np.arange(start, stop)
+                # align bisection splits to the per-shard chunk extent:
+                # sub-ranges then keep every design at the same local
+                # row position (j % chunk_local) it held in the original
+                # dispatch, so healthy rows recovered by bisection are
+                # bit-identical to an unfaulted run — and to the
+                # single-device bisection of the same fault
                 merged, quarantined = run_isolated(
-                    _exec_rows, rows_idx, retries=1, display=display)
+                    _exec_rows, rows_idx, retries=1, display=display,
+                    align=chunk_local)
                 ok = ~quarantined
                 if merged is not None and ok.any():
                     hb_rows = None
@@ -1307,9 +1417,11 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                     idx[n_real:] = stop - 1
                     run.emit("chunk_dispatch", chunk=start // chunk_size,
                              start=start, stop=stop, n_real=n_real,
-                             in_flight=len(pending) + 1)
+                             in_flight=len(pending) + 1,
+                             devices=[int(d.id) for d in devices])
                     try:
-                        entry = (start, stop, n_real) + _dispatch(idx)
+                        entry = (start, stop, n_real) + _dispatch(
+                            idx, start // chunk_size)
                     except Exception as e:  # noqa: BLE001 - isolation boundary
                         _isolate(start, stop, e)
                         continue
@@ -1319,14 +1431,19 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 for entry in pending:
                     _safe_commit(entry)
             finally:
-                # flush the final checkpoint snapshot before returning
-                # (or before propagating an abort — the on-disk file then
-                # reflects every committed chunk, same as the old
-                # synchronous saves)
-                if ckpt_writer is not None:
-                    ckpt_writer.close()
+                # join the isolation worker first (it stores results and
+                # submits checkpoints), THEN flush the final checkpoint
+                # snapshot — the on-disk file then reflects every
+                # committed AND every quarantined chunk, same as the old
+                # synchronous saves.  drain() re-raises any unexpected
+                # isolation error on this thread.
+                try:
+                    isolator.drain()
+                finally:
+                    if ckpt_writer is not None:
+                        ckpt_writer.close()
         if run.enabled:
-            obs_ledger.emit_device_memory(run, device=device,
+            obs_ledger.emit_device_memory(run, device=devices,
                                           what="post_chunks")
         return _finalize()
 
@@ -1420,11 +1537,18 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
 
 
 def _save_checkpoint(checkpoint, sig, results, done, props, nacelle_acc,
-                     status, health_resid, health_cond):
+                     status, health_resid, health_cond, mesh_shape=None):
     import os
 
+    extra = {}
+    if mesh_shape is not None:
+        # recorded for post-mortem attribution only: resume is
+        # deliberately topology-independent (per-design state carries no
+        # shard identity, so a 1-device resume of an 8-device sweep — or
+        # the reverse — picks up exactly where the checkpoint left off)
+        extra["mesh_shape"] = np.asarray(mesh_shape, dtype=np.int64)
     tmp = f"{checkpoint}.{os.getpid()}.tmp.npz"  # .npz: savez keeps the name
     np.savez(tmp, sig=sig, motion_std=results, done=done, AxRNA_std=nacelle_acc,
              status=status, health_resid=health_resid, health_cond=health_cond,
-             **props)
+             **extra, **props)
     os.replace(tmp, checkpoint)

@@ -1,0 +1,120 @@
+"""Mesh-executor attestation: run the demo sweep single-device and on
+the full virtual-device mesh in THIS process and assert, from numpy and
+the run ledger, the mesh executor's contract:
+
+- the mesh sweep is BIT-IDENTICAL to the single-device sweep (every
+  result array, dtype included — health and status too);
+- the warm mesh repeat performs ZERO real XLA compiles (the chunk
+  executables are memoized per mesh topology; RecompileSentinel and the
+  ledger both attest);
+- every shard carried real rows (the per-device d2h split in the
+  ledger's chunk_fetch events names each device).
+
+CI runs it on an 8-virtual-device CPU mesh and gates the warm ledger
+with `obs.history check --require "real_compiles<=0"`:
+
+    python scripts/mesh_check.py --devices 8 --ledger mesh-ledgers
+"""
+
+import argparse
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+
+def _read_single_run(ledger_dir):
+    from raft_tpu.obs import ledger as obs_ledger
+
+    runs = obs_ledger.list_runs(ledger_dir)
+    assert len(runs) == 1, f"expected one ledger run in {ledger_dir}: {runs}"
+    return obs_ledger.read_events(runs[0])
+
+
+def main():
+    ap = argparse.ArgumentParser(description=__doc__)
+    ap.add_argument("--devices", type=int, default=8,
+                    help="virtual CPU mesh size (default 8)")
+    ap.add_argument("--ledger", default="mesh-ledgers",
+                    help="parent dir for the single/cold/warm run ledgers")
+    args = ap.parse_args()
+
+    from raft_tpu import config as _config
+
+    _config.force_host_mesh(args.devices)
+
+    import numpy as np
+    import jax
+
+    from raft_tpu.analysis.recompile import RecompileSentinel
+    from raft_tpu.designs import demo_spar
+    from raft_tpu.sweep import sweep
+
+    devs = jax.devices()
+    assert len(devs) >= args.devices, (
+        f"need {args.devices} devices, have {len(devs)}")
+    devs = devs[:args.devices]
+
+    design = demo_spar(nw_freqs=(0.05, 0.4))
+    base = np.array([9.4, 9.4, 6.5, 6.5])
+    axes = [("platform.members.0.d",
+             [(base + 0.05 * i).tolist() for i in range(2 * args.devices)])]
+    states = [(4.0, 8.0), (6.0, 10.0)]
+    # chunk 2 x 2*devices designs fills every shard with real rows
+    kw = dict(n_iter=8, chunk_size=2)
+
+    def run(tag, **extra):
+        os.environ["RAFT_TPU_LEDGER"] = os.path.join(args.ledger, tag)
+        try:
+            return sweep(design, axes, states, **kw, **extra)
+        finally:
+            del os.environ["RAFT_TPU_LEDGER"]
+
+    single = run("single", device=devs[0])
+    cold = run("mesh-cold", devices=devs)
+    with RecompileSentinel() as s:
+        warm = run("mesh-warm", devices=devs)
+    assert s.backend_compiles == 0, (
+        f"warm mesh sweep performed {s.backend_compiles} real XLA "
+        f"compiles: {dict(s.compiles_by_name)}")
+
+    # ---- bit-identity: every array, dtype included --------------------
+    for out, tag in ((cold, "cold"), (warm, "warm")):
+        for k in ("motion_std", "AxRNA_std", "mass", "displacement",
+                  "GMT", "status"):
+            a, b = np.asarray(single[k]), np.asarray(out[k])
+            assert a.dtype == b.dtype, (tag, k, a.dtype, b.dtype)
+            np.testing.assert_array_equal(a, b, err_msg=f"{tag}:{k}")
+        for k in single["health"]:
+            np.testing.assert_array_equal(
+                np.asarray(single["health"][k]),
+                np.asarray(out["health"][k]), err_msg=f"{tag}:health.{k}")
+    assert np.all(np.isfinite(single["motion_std"])), "non-finite output"
+
+    # ---- ledger: the mesh plan + per-shard d2h actually happened ------
+    events = _read_single_run(os.path.join(args.ledger, "mesh-warm"))
+    by = {}
+    for ev in events:
+        by.setdefault(ev["event"], []).append(ev)
+    plan = by["plan"][0]
+    assert plan["mesh"][0] == args.devices, plan
+    assert len(plan["devices"]) == args.devices, plan
+    fetches = by["chunk_fetch"]
+    shards = set()
+    for ev in fetches:
+        shards.update((ev.get("per_device") or {}).keys())
+    assert len(shards) == args.devices, (
+        f"d2h split names {sorted(shards)}, expected {args.devices} shards")
+    warm_compiles = [e for e in by.get("compile_start", ())
+                     if e.get("real")]
+    assert not warm_compiles, (
+        f"warm mesh ledger recorded real compiles: {warm_compiles}")
+
+    print(f"mesh_check OK: {len(axes[0][1])} designs x {len(states)} cases "
+          f"on a {plan['mesh'][0]}x{plan['mesh'][1]} (design,case) mesh — "
+          f"bit-identical to single-device, warm repeat 0 XLA compiles, "
+          f"{len(shards)} shards fetched")
+
+
+if __name__ == "__main__":
+    main()

@@ -1,0 +1,410 @@
+"""Mesh-native production sweep (the (design, case) mesh executor).
+
+The mesh's contract mirrors the executor's: topology changes
+SCHEDULING, never results.  A sweep over the 8-virtual-device CPU mesh
+(conftest forces ``--xla_force_host_platform_device_count=8``) must be
+bit-identical to the single-device run — same dtypes, same health and
+status arrays — at pipeline depth 1 and 3 and through a fault-injected
+chunk, with zero extra XLA compiles once the executables are warm.
+The guarantee rests on the per-shard design extent equalling the
+single-device chunk extent (every shard compiles the exact local
+shapes of the 1x1 mesh), so these tests pin that tiling through the
+ledger's ``plan`` event as well.
+"""
+
+import threading
+
+import numpy as np
+import pytest
+
+import jax
+
+from raft_tpu import config as _config
+from raft_tpu import sweep as sweep_mod
+from raft_tpu.designs import demo_spar
+from raft_tpu.obs import ledger as obs_ledger
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.robust import STATUS_OK, STATUS_QUARANTINED
+from raft_tpu.sweep import _design_case_mesh, sweep
+
+AXES = [("platform.members.0.d",
+         [[9.4, 9.4, 6.5, 6.5], [10.0, 10.0, 6.5, 6.5],
+          [10.5, 10.5, 6.5, 6.5], [11.0, 11.0, 6.5, 6.5],
+          [9.0, 9.0, 6.5, 6.5], [9.6, 9.6, 6.5, 6.5],
+          [10.2, 10.2, 6.5, 6.5], [10.8, 10.8, 6.5, 6.5]])]
+STATES = [(4.0, 8.0), (6.0, 10.0)]
+
+RESULT_KEYS = ("motion_std", "AxRNA_std", "mass", "displacement", "GMT",
+               "status")
+
+
+def _sweep(**kw):
+    kw.setdefault("n_iter", 8)
+    kw.setdefault("chunk_size", 2)
+    return sweep(demo_spar(nw_freqs=(0.05, 0.4)), AXES, STATES, **kw)
+
+
+def _assert_bit_identical(a, b):
+    """Every result array — metrics, mass properties, health leaves,
+    status — must match bit-for-bit INCLUDING dtype."""
+    for k in RESULT_KEYS:
+        x, y = np.asarray(a[k]), np.asarray(b[k])
+        assert x.dtype == y.dtype, (k, x.dtype, y.dtype)
+        np.testing.assert_array_equal(x, y, err_msg=k)
+    for k in a["health"]:
+        x, y = np.asarray(a["health"][k]), np.asarray(b["health"][k])
+        assert x.dtype == y.dtype, (f"health.{k}", x.dtype, y.dtype)
+        np.testing.assert_array_equal(x, y, err_msg=f"health.{k}")
+
+
+# ---------------------------------------------------------------------------
+# mesh selection (config + factorization)
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_spec_parsing(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_MESH", raising=False)
+    assert _config.mesh_spec() is None
+    monkeypatch.setenv("RAFT_TPU_MESH", "all")
+    assert _config.mesh_spec() == ("all",)
+    monkeypatch.setenv("RAFT_TPU_MESH", "auto")
+    assert _config.mesh_spec() == ("all",)
+    monkeypatch.setenv("RAFT_TPU_MESH", "4")
+    assert _config.mesh_spec() == ("count", 4)
+    monkeypatch.setenv("RAFT_TPU_MESH", "4x2")
+    assert _config.mesh_spec() == ("shape", 4, 2)
+    monkeypatch.setenv("RAFT_TPU_MESH", "bogus")
+    with pytest.raises(ValueError, match="RAFT_TPU_MESH"):
+        _config.mesh_spec()
+
+
+def test_resolve_mesh_devices(monkeypatch):
+    devs = jax.devices()
+    assert len(devs) >= 8  # conftest virtual mesh
+
+    # no env, no request: single-device degenerate mesh
+    monkeypatch.delenv("RAFT_TPU_MESH", raising=False)
+    got, shape = _config.resolve_mesh_devices(None, None)
+    assert got == [devs[0]] and shape is None
+
+    # an explicit device list always wins over the env
+    monkeypatch.setenv("RAFT_TPU_MESH", "all")
+    got, shape = _config.resolve_mesh_devices(devs[:2], None)
+    assert got == list(devs[:2]) and shape is None
+    with pytest.raises(ValueError, match="empty"):
+        _config.resolve_mesh_devices([], None)
+
+    got, shape = _config.resolve_mesh_devices(None, None)
+    assert got == list(devs) and shape is None
+
+    monkeypatch.setenv("RAFT_TPU_MESH", "4")
+    got, shape = _config.resolve_mesh_devices(None, None)
+    assert got == list(devs[:4]) and shape is None
+
+    monkeypatch.setenv("RAFT_TPU_MESH", "4x2")
+    got, shape = _config.resolve_mesh_devices(None, None)
+    assert got == list(devs[:8]) and shape == (4, 2)
+
+    monkeypatch.setenv("RAFT_TPU_MESH", str(len(devs) + 1))
+    with pytest.raises(ValueError, match="device"):
+        _config.resolve_mesh_devices(None, None)
+
+
+def test_design_case_mesh_factorization():
+    devs = jax.devices()[:8]
+    # default: every device on the design axis (the bit-identity choice)
+    mesh = _design_case_mesh(devs, n_cases=2)
+    assert mesh.devices.shape == (8, 1)
+    assert mesh.axis_names == ("design", "case")
+    # one device is the degenerate 1x1 mesh of the same code path
+    assert _design_case_mesh(devs[:1], n_cases=7).devices.shape == (1, 1)
+    # an explicit shape pins the factorization
+    assert _design_case_mesh(devs, 2, shape=(4, 2)).devices.shape == (4, 2)
+    with pytest.raises(ValueError, match="does not use"):
+        _design_case_mesh(devs, 2, shape=(4, 1))
+    with pytest.raises(ValueError, match="does not divide"):
+        _design_case_mesh(devs, 3, shape=(4, 2))
+
+
+# ---------------------------------------------------------------------------
+# bit-identity + zero recompiles (the tentpole contract)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.sentinel
+def test_mesh_bit_identical_no_recompile(monkeypatch):
+    """Single-device vs the full 8-device design mesh, at pipeline depth
+    1 and 3 and through a fault-injected chunk: bit-identical results
+    (all dtypes, health + status arrays) and ZERO new XLA compiles once
+    both topologies are warm."""
+    from raft_tpu.analysis.recompile import RecompileSentinel
+
+    devs = jax.devices()
+    # chunk_size=1 on 8 designs fills all 8 shards (global chunk 8)
+    base = _sweep(chunk_size=1, device=devs[0])   # warm the 1x1 mesh
+    meshed = _sweep(chunk_size=1, devices=devs)   # warm the 8x1 mesh
+    assert (base["status"] == STATUS_OK).all()
+    _assert_bit_identical(base, meshed)
+
+    with RecompileSentinel() as s:
+        snap = s.snapshot()
+
+        repeat = _sweep(chunk_size=1, devices=devs)
+        s.assert_no_recompile(snap, "warm mesh sweep")
+        _assert_bit_identical(base, repeat)
+
+        monkeypatch.setenv("RAFT_TPU_PIPELINE", "1")
+        depth1 = _sweep(chunk_size=1, devices=devs)
+        s.assert_no_recompile(snap, "depth-1 mesh sweep")
+        _assert_bit_identical(base, depth1)
+
+        monkeypatch.setenv("RAFT_TPU_PIPELINE", "3")
+        depth3 = _sweep(chunk_size=1, devices=devs)
+        s.assert_no_recompile(snap, "depth-3 mesh sweep")
+        _assert_bit_identical(base, depth3)
+        monkeypatch.delenv("RAFT_TPU_PIPELINE")
+
+        # a persistently faulting design: retry, then bisection down the
+        # shard tiling — the re-runs ride the SAME chunk executables
+        poison = 5
+
+        def hook(idx, dispatch):
+            if (np.asarray(idx) == poison).any():
+                raise RuntimeError("injected chunk fault")
+            return dispatch(idx)
+
+        monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", hook)
+        with pytest.warns(RuntimeWarning, match="isolating faults"):
+            faulted = _sweep(chunk_size=1, devices=devs)
+        s.assert_no_recompile(snap, "fault-isolating mesh sweep")
+        monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", None)
+
+    assert faulted["status"][poison] == STATUS_QUARANTINED
+    ok = faulted["status"] == STATUS_OK
+    assert ok.tolist() == [i != poison for i in range(8)]
+    # healthy rows recovered by bisection are bit-identical too (the
+    # align= snapping keeps every design at its original local row)
+    np.testing.assert_array_equal(faulted["motion_std"][ok],
+                                  base["motion_std"][ok])
+    assert np.isnan(faulted["motion_std"][poison]).all()
+
+
+def test_mesh_auto_sizes_design_axis_to_workload():
+    """Shards past ceil(n_designs / chunk) would hold only padding; the
+    sweep drops them instead (8 designs / chunk 4 -> 2 of 8 devices),
+    and the result is still bit-identical to single-device."""
+    devs = jax.devices()
+    base = _sweep(chunk_size=4, device=devs[0])
+    meshed = _sweep(chunk_size=4, devices=devs)
+    _assert_bit_identical(base, meshed)
+
+
+def test_mesh_explicit_case_axis_shape(monkeypatch):
+    """RAFT_TPU_MESH=DxC pins the factorization.  A case extent > 1
+    shrinks each shard's local sea-state batch, so this path promises
+    fp-tolerance agreement (status exactly), not bitwise."""
+    devs = jax.devices()
+    base = _sweep(chunk_size=2, device=devs[0])
+    monkeypatch.setenv("RAFT_TPU_MESH", "4x2")
+    meshed = _sweep(chunk_size=2)
+    np.testing.assert_array_equal(base["status"], meshed["status"])
+    np.testing.assert_allclose(meshed["motion_std"], base["motion_std"],
+                               rtol=1e-12, atol=0)
+    np.testing.assert_allclose(meshed["AxRNA_std"], base["AxRNA_std"],
+                               rtol=1e-12, atol=0)
+
+
+# ---------------------------------------------------------------------------
+# ledger: plan tiling, per-device dispatch, fault/dispatch overlap
+# ---------------------------------------------------------------------------
+
+
+def _ledger_sweep(tmp_path, monkeypatch, name, **kw):
+    ldir = tmp_path / name
+    monkeypatch.setenv("RAFT_TPU_LEDGER", str(ldir))
+    out = _sweep(**kw)
+    monkeypatch.delenv("RAFT_TPU_LEDGER")
+    runs = obs_ledger.list_runs(str(ldir))
+    assert len(runs) == 1, runs
+    return out, obs_ledger.read_events(runs[0])
+
+
+def test_mesh_ledger_plan_and_dispatch(tmp_path, monkeypatch):
+    devs = jax.devices()
+    _sweep(chunk_size=2, devices=devs)  # warm
+    out, events = _ledger_sweep(tmp_path, monkeypatch, "mesh",
+                                chunk_size=2, devices=devs)
+    assert (out["status"] == STATUS_OK).all()
+    by = {}
+    for ev in events:
+        by.setdefault(ev["event"], []).append(ev)
+
+    plan = by["plan"][0]
+    # 8 designs / chunk 2 -> 4 useful shards; the global chunk is 4
+    # single-device-shaped chunks (the per-shard extent stays 2)
+    assert plan["mesh"] == [4, 1]
+    assert plan["chunk_size"] == 8 and plan["n_chunks"] == 1
+    assert len(plan["devices"]) == 4
+
+    disp = by["chunk_dispatch"][0]
+    assert disp["devices"] == plan["devices"]
+    fetch = by["chunk_fetch"][0]
+    # per-shard d2h split: one entry per device, bytes on each
+    per_device = fetch.get("per_device")
+    assert per_device and len(per_device) == 4
+    assert all(b > 0 for b in per_device.values())
+
+
+def test_mesh_fault_does_not_stall_other_shards(tmp_path, monkeypatch):
+    """Overlap proof: while one global chunk's fault is being isolated
+    on the worker, the main loop keeps dispatching the next chunk.  The
+    hook makes it deterministic — the isolation re-run cannot raise (so
+    the quarantine cannot land) until chunk 1 has been dispatched."""
+    devs = jax.devices()
+    monkeypatch.setenv("RAFT_TPU_PIPELINE", "1")
+    _sweep(chunk_size=1, devices=devs[:4])  # warm (4x1 mesh, 2 chunks)
+
+    seen_chunk1 = threading.Event()
+    first_call = {"live": True}
+
+    def hook(idx, dispatch):
+        idx = np.asarray(idx)
+        if idx[0] == 4:  # second global chunk reached the executor
+            seen_chunk1.set()
+        if (idx == 0).any():
+            if first_call["live"]:
+                first_call["live"] = False  # dispatch-time fault, main loop
+            else:
+                # isolation re-run (worker thread): hold the fault until
+                # the main loop has provably moved on to chunk 1
+                assert seen_chunk1.wait(30.0)
+            raise RuntimeError("injected chunk fault")
+        return dispatch(idx)
+
+    monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", hook)
+    ldir = tmp_path / "overlap"
+    monkeypatch.setenv("RAFT_TPU_LEDGER", str(ldir))
+    with pytest.warns(RuntimeWarning, match="isolating faults"):
+        out = _sweep(chunk_size=1, devices=devs[:4])
+    monkeypatch.delenv("RAFT_TPU_LEDGER")
+    monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", None)
+
+    assert out["status"][0] == STATUS_QUARANTINED
+    assert (out["status"][1:] == STATUS_OK).all()
+
+    events = obs_ledger.read_events(obs_ledger.list_runs(str(ldir))[0])
+    names = [ev["event"] for ev in events]
+    i_fault = names.index("chunk_fault")
+    i_disp1 = next(i for i, ev in enumerate(events)
+                   if ev["event"] == "chunk_dispatch" and ev["chunk"] == 1)
+    i_quar = names.index("design_quarantined")
+    # the ledger timeline proves the overlap: fault recorded, NEXT chunk
+    # dispatched, and only then the quarantine from the worker
+    assert i_fault < i_disp1 < i_quar
+
+
+# ---------------------------------------------------------------------------
+# per-device live metrics
+# ---------------------------------------------------------------------------
+
+
+@pytest.fixture
+def metrics_env(monkeypatch):
+    monkeypatch.delenv("RAFT_TPU_LEDGER", raising=False)
+    monkeypatch.setenv("RAFT_TPU_METRICS", "1")
+    obs_metrics.reset()
+    yield obs_metrics
+    from raft_tpu.obs import live as obs_live
+
+    obs_live.stop_server()
+    obs_metrics.reset()
+
+
+def test_per_device_metrics_labels(metrics_env):
+    """The same event vocabulary a mesh run emits must label transfer
+    bytes and memory gauges per device and expose per-device in-flight
+    depth through /status."""
+    obs_metrics.observe_event("run_start", {
+        "t": 1.0, "run_id": "m1", "kind": "sweep",
+        "fingerprint": {"n_designs": 8, "n_cases": 2}})
+    obs_metrics.observe_event("chunk_dispatch", {
+        "chunk": 0, "in_flight": 2, "devices": [0, 1, 2, 3]})
+    obs_metrics.observe_event("chunk_fetch", {
+        "chunk": 0, "bytes": 40, "per_device": {"0": 10, "1": 30}})
+    obs_metrics.observe_event("transfer", {
+        "what": "resident_batch", "direction": "h2d", "bytes": 64,
+        "per_device": {"0": 32, "1": 32}})
+    obs_metrics.observe_event("transfer", {
+        "what": "design_params", "direction": "h2d", "bytes": 8})
+    obs_metrics.observe_event("device_memory", {
+        "device": "cpu:1", "bytes_in_use": 123, "peak_bytes": 456})
+
+    m = obs_metrics.std()
+    assert m.transfer_bytes.value(direction="d2h", device="0") == 10
+    assert m.transfer_bytes.value(direction="d2h", device="1") == 30
+    assert m.transfer_bytes.value(direction="h2d", device="0") == 32
+    # events with no split stay on the aggregate label
+    assert m.transfer_bytes.value(direction="h2d", device="all") == 8
+    assert m.device_bytes_in_use.value(device="cpu:1") == 123
+    assert m.device_peak_bytes.value(device="cpu:1") == 456
+
+    st = obs_metrics.status_snapshot()["active"]
+    assert st["per_device_in_flight"] == {
+        "0": 2, "1": 2, "2": 2, "3": 2}
+
+
+def test_shard_bytes_per_device_split():
+    """obs_ledger.shard_bytes splits a sharded pytree's footprint by
+    device id (the source of every per_device event field)."""
+    from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+    devs = jax.devices()[:4]
+    mesh = Mesh(np.asarray(devs).reshape(4, 1), ("design", "case"))
+    x = jax.device_put(np.zeros((8, 3), dtype=np.float64),
+                       NamedSharding(mesh, P("design")))
+    split = obs_ledger.shard_bytes([x])
+    assert set(split) == {str(d.id) for d in devs}
+    assert all(b == 2 * 3 * 8 for b in split.values())  # 2 rows x 3 f64
+
+
+# ---------------------------------------------------------------------------
+# checkpointing across topologies
+# ---------------------------------------------------------------------------
+
+
+def test_mesh_checkpoint_records_topology_and_resumes_anywhere(
+        tmp_path, monkeypatch):
+    """A mesh sweep's checkpoint records the mesh shape (post-mortem
+    attribution) but resume is topology-independent: a single-device
+    resume of an 8-device sweep picks up where the checkpoint left off,
+    bit-identically."""
+    devs = jax.devices()
+    ckpt = str(tmp_path / "mesh.ckpt")
+    base = _sweep(chunk_size=2, device=devs[0])
+
+    # fault chunk 1 at dispatch so the mesh sweep quarantines design 5;
+    # its checkpoint then has real per-design state to resume from
+    poison = 5
+
+    def hook(idx, dispatch):
+        if (np.asarray(idx) == poison).any():
+            raise RuntimeError("injected chunk fault")
+        return dispatch(idx)
+
+    monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", hook)
+    with pytest.warns(RuntimeWarning, match="isolating faults"):
+        meshed = _sweep(chunk_size=2, devices=devs, checkpoint=ckpt)
+    monkeypatch.setattr(sweep_mod, "_CHUNK_EXEC_HOOK", None)
+    assert meshed["status"][poison] == STATUS_QUARANTINED
+
+    with np.load(ckpt) as snap:
+        assert snap["mesh_shape"].tolist() == [4, 1]
+        assert bool(snap["done"].all())
+
+    # resume on ONE device: every design is done, nothing recomputes,
+    # and the quarantined row survives the topology change
+    resumed = _sweep(chunk_size=2, device=devs[0], checkpoint=ckpt)
+    assert resumed["status"][poison] == STATUS_QUARANTINED
+    ok = resumed["status"] == STATUS_OK
+    np.testing.assert_array_equal(resumed["motion_std"][ok],
+                                  base["motion_std"][ok])
