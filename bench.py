@@ -165,11 +165,17 @@ def main():
         for ev in events:
             name = ev.get("event", "?")
             counts[name] = counts.get(name, 0) + 1
+        from raft_tpu.obs import timeline as obs_timeline
+
         ledger_detail.update({
             "newest": runs[-1],
             "events": len(events),
             "schema_errors": obs_schema.validate_events(events),
             "event_counts": counts,
+            # the warm run's ledger must also round-trip through the
+            # Chrome-trace exporter (obs.timeline) without schema errors
+            "timeline_errors": obs_timeline.validate_trace(
+                obs_timeline.build_trace(events)),
         })
         if mesh_mode:
             # mesh attribution from the warm run's plan event: the shape

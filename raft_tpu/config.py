@@ -306,6 +306,66 @@ def obs_config(overrides=None) -> dict:
     return cfg
 
 
+# ---------------------------------------------------------------------------
+# solver flight recorder (raft_tpu.obs.flightrec)
+# ---------------------------------------------------------------------------
+
+# Defaults for the solver flight recorder: per-iteration Borgman
+# convergence telemetry (the `lax.scan` ys of the health solver) and
+# anomaly capture-and-replay bundles (see docs/observability.md,
+# "Flight recorder & timelines").  Everything is OFF by default — the
+# off path is sentinel-pinned to the exact executables and bit-identical
+# results of a recorder-less sweep.  Environment overrides:
+# RAFT_TPU_FLIGHTREC=<dir> arms capture (bundles land under <dir>),
+# RAFT_TPU_FLIGHTREC_CONV=0 keeps capture armed but drops the
+# per-iteration residual trace from the compiled program,
+# RAFT_TPU_FLIGHTREC_SEVERITY=<name|code> sets the minimum status
+# severity that triggers a bundle (default "nan": NaN + quarantined),
+# RAFT_TPU_FLIGHTREC_MAX=<n> bounds bundles per run.
+FLIGHTREC_DEFAULTS = {
+    "enabled": False,
+    "dir": None,
+    "convergence": True,   # emit the per-iteration residual trace
+    "severity": "nan",     # min status (robust.STATUS_* name or code)
+    "max_bundles": 16,     # per-run capture budget
+}
+
+
+def flightrec_config(overrides=None) -> dict:
+    """Effective flight-recorder configuration: defaults, then
+    environment, then explicit ``overrides`` (e.g.
+    ``sweep(..., flightrec={...})``)."""
+    import os
+
+    cfg = dict(FLIGHTREC_DEFAULTS)
+    env = os.environ.get("RAFT_TPU_FLIGHTREC")
+    if env is not None:
+        cfg["dir"] = env or None
+        cfg["enabled"] = bool(env)
+    env = os.environ.get("RAFT_TPU_FLIGHTREC_CONV")
+    if env is not None:
+        cfg["convergence"] = env not in ("0", "false", "")
+    env = os.environ.get("RAFT_TPU_FLIGHTREC_SEVERITY")
+    if env is not None:
+        # stored raw (name or numeric string); resolution against the
+        # robust.STATUS_* vocabulary happens in obs.flightrec so this
+        # module never imports the robust layer
+        cfg["severity"] = env
+    env = os.environ.get("RAFT_TPU_FLIGHTREC_MAX")
+    if env is not None:
+        cfg["max_bundles"] = max(0, int(env))
+    if overrides:
+        unknown = set(overrides) - set(cfg)
+        if unknown:
+            raise ValueError(
+                f"unknown flightrec config key(s): {sorted(unknown)}")
+        cfg.update(overrides)
+    # `enabled` arms the recorder; convergence telemetry needs only
+    # that, while anomaly capture additionally needs a bundle `dir`
+    # (an armed recorder without a directory records traces, not files)
+    return cfg
+
+
 # Solver-path selection for the batched 6x6 impedance solves
 # (raft_tpu.parallel.smallsolve): 'auto' benchmarks the Pallas kernel
 # against the plain-jnp elimination at first use per (n, m, B, backend)

@@ -1,0 +1,414 @@
+"""Solver flight recorder: anomaly capture bundles and standalone replay.
+
+A thousand-design sweep that quarantines design #847 at 3 a.m. leaves
+you a status code and a warning line — not the inputs that produced the
+failure.  The flight recorder closes that loop: when the sweep's
+quarantine bisection gives a design up (or a health classification
+crosses a configured severity), it writes a **replay bundle** — a
+self-contained directory holding everything needed to re-run that one
+design standalone:
+
+* the fully *mutated* design dict (axis combo already applied, so the
+  bundle needs neither the axes nor the base design to run),
+* the environment (sea states, wind cases, iteration count, health
+  tolerances, chunk extent, backend/x64 flags, design fingerprint),
+* the design's stacked input leaves (the exact rows the chunk
+  executable consumed), and
+* the recorded outputs where they exist: response rows, per-case
+  ``SolveHealth`` arrays, classified status, and the per-iteration
+  Borgman residual trace when convergence telemetry was on.
+
+``python -m raft_tpu.obs.flightrec replay <bundle>`` then re-runs the
+design through the same batched sweep path (``sweep(design, axes=[],
+...)``) and diffs the replay against the recorded arrays — the
+"capture on the pod, reproduce on a workstation" workflow
+docs/robustness.md describes.
+
+Arming: ``RAFT_TPU_FLIGHTREC=dir`` (or ``sweep(...,
+flightrec={"dir": ...})``).  Off by default; the recorder is
+constructed only on the armed path, so the unarmed sweep runs the
+seed's exact trace.  See :data:`raft_tpu.config.FLIGHTREC_DEFAULTS`.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import time
+
+import numpy as np
+
+from ..robust.health import (STATUS_ILLCOND, STATUS_NAMES, STATUS_NONCONV,
+                             STATUS_QUARANTINED, status_name)
+from . import ledger as obs_ledger
+from . import log as obs_log
+
+__all__ = ["Recorder", "resolve_severity", "load_bundle", "replay", "main"]
+
+_LOG = obs_log.get_logger("obs.flightrec")
+
+META_NAME = "meta.json"
+ARRAYS_NAME = "arrays.npz"
+
+# recorded-output array names in ARRAYS_NAME; health leaves are stored
+# flat as health_<leaf>
+_RECORDED_KEYS = ("std", "a_std", "resid_trace")
+_HEALTH_KEYS = ("resid", "cond", "nonfinite", "n_fallback")
+
+
+def resolve_severity(severity):
+    """Map a config ``severity`` (status name, shorthand, or int code)
+    to the int8 status threshold at which captures trigger."""
+    if isinstance(severity, (int, np.integer)) and not isinstance(
+            severity, bool):
+        return int(severity)
+    key = str(severity).strip().lower().replace("_", "-")
+    table = {name: code for code, name in STATUS_NAMES.items()}
+    table.update({
+        "nonconv": STATUS_NONCONV, "nonconverged": STATUS_NONCONV,
+        "illcond": STATUS_ILLCOND, "ill-cond": STATUS_ILLCOND,
+        "quarantine": STATUS_QUARANTINED,
+    })
+    if key not in table:
+        raise ValueError(
+            f"unknown flightrec severity {severity!r}; expected one of "
+            f"{sorted(set(table))} or an int status code")
+    return int(table[key])
+
+
+def _jsonable(x):
+    if isinstance(x, np.ndarray):
+        return x.tolist()
+    if isinstance(x, np.integer):
+        return int(x)
+    if isinstance(x, np.floating):
+        return float(x)
+    if isinstance(x, np.bool_):
+        return bool(x)
+    if isinstance(x, tuple):
+        return list(x)
+    raise TypeError(f"not JSON-serializable: {type(x).__name__}")
+
+
+def _fingerprint(design_json: str) -> str:
+    import hashlib
+
+    return hashlib.sha256(design_json.encode()).hexdigest()[:16]
+
+
+class Recorder:
+    """Per-sweep anomaly capture hook (constructed by ``sweep()`` when
+    the flight recorder is armed with a bundle directory).
+
+    ``capture`` is called from the sweep's commit path (severity
+    triggers) and from the quarantine runner's ``on_quarantine``
+    callback; both run on the host between chunk dispatches, and a
+    failing capture never propagates (the quarantine hook swallows it,
+    and severity captures guard themselves the same way).
+    """
+
+    def __init__(self, *, base_design, axes, combos, sea_states, wind,
+                 n_iter, hcfg, fcfg, chunk_size, run, stacked=None):
+        self._base_design = base_design
+        self._axes = axes
+        self._combos = combos
+        self._sea_states = sea_states
+        self._wind = wind
+        self._n_iter = int(n_iter)
+        self._hcfg = dict(hcfg)
+        self._chunk_size = int(chunk_size)
+        self._run = run
+        self._stacked = stacked
+        self.dir = fcfg["dir"]
+        self.severity = resolve_severity(fcfg["severity"])
+        self.max_bundles = int(fcfg["max_bundles"])
+        self._written = 0
+        self._seen: set = set()
+
+    def capture(self, design_idx, *, trigger, status, error=None,
+                recorded=None):
+        """Write one replay bundle; returns its path (None if skipped).
+
+        Never raises: capture is an observer of the sweep, not a
+        participant — an unwritable directory must not change what the
+        sweep computes or quarantines.
+        """
+        try:
+            return self._capture(design_idx, trigger=trigger, status=status,
+                                 error=error, recorded=recorded)
+        except Exception as e:  # noqa: BLE001 - observer only
+            obs_log.warn(
+                _LOG,
+                f"flightrec: capture failed for design {design_idx} "
+                f"({type(e).__name__}: {e})",
+                RuntimeWarning)
+            return None
+
+    def _capture(self, design_idx, *, trigger, status, error, recorded):
+        design_idx = int(design_idx)
+        if design_idx in self._seen:
+            return None
+        self._seen.add(design_idx)
+        if self._written >= self.max_bundles:
+            obs_log.warn_once(
+                _LOG, ("flightrec_max", self.dir),
+                f"flightrec: bundle cap reached ({self.max_bundles}); "
+                "further captures dropped (raise RAFT_TPU_FLIGHTREC_MAX)")
+            return None
+
+        import copy
+
+        from ..parallel.design_batch import set_in_design
+
+        design = copy.deepcopy(self._base_design)
+        combo = self._combos[design_idx]
+        for (path, _), value in zip(self._axes, combo):
+            set_in_design(design, path, value)
+        design_json = json.dumps(design, default=_jsonable, sort_keys=True)
+
+        run_id = getattr(self._run, "run_id", None)
+        name = f"design{design_idx:05d}-{trigger}"
+        if run_id:
+            name = f"{run_id}-{name}"
+        path = os.path.join(self.dir, name)
+        os.makedirs(path, exist_ok=True)
+
+        import jax
+
+        meta = {
+            "version": 1,
+            "kind": "raft_tpu.flightrec.bundle",
+            "t": time.time(),
+            "design_index": design_idx,
+            "trigger": trigger,
+            "status": int(status),
+            "status_name": status_name(status),
+            "error": (f"{type(error).__name__}: {error}"
+                      if error is not None else None),
+            "run_id": run_id,
+            "fingerprint": _fingerprint(design_json),
+            "design": json.loads(design_json),
+            "combo": json.loads(json.dumps(list(combo), default=_jsonable)),
+            "axes": [str(p) for p, _ in self._axes],
+            "sea_states": [list(map(float, s)) for s in self._sea_states],
+            "wind": self._wind,
+            "n_iter": self._n_iter,
+            "chunk_size": self._chunk_size,
+            "health": self._hcfg,
+            "backend": jax.default_backend(),
+            "x64": bool(jax.config.jax_enable_x64),
+        }
+        arrays = {}
+        if recorded:
+            for k in _RECORDED_KEYS:
+                if recorded.get(k) is not None:
+                    arrays[k] = np.asarray(recorded[k])
+            for k, v in (recorded.get("health") or {}).items():
+                arrays[f"health_{k}"] = np.asarray(v)
+        if self._stacked is not None:
+            # the exact input rows the chunk executable consumed for
+            # this design, one leading-axis slice per stacked leaf
+            for i, leaf in enumerate(self._stacked):
+                arrays[f"input_leaf_{i:03d}"] = np.asarray(leaf[design_idx])
+
+        tmp = os.path.join(path, META_NAME + ".tmp")
+        with open(tmp, "w") as f:
+            json.dump(meta, f, default=_jsonable, indent=1)
+        os.replace(tmp, os.path.join(path, META_NAME))
+        if arrays:
+            tmp = os.path.join(path, ARRAYS_NAME + ".tmp.npz")
+            np.savez(tmp, **arrays)
+            os.replace(tmp, os.path.join(path, ARRAYS_NAME))
+
+        self._written += 1
+        self._run.emit("replay_bundle", design=design_idx, path=path,
+                       trigger=trigger, status=status_name(status))
+        obs_log.display(
+            _LOG,
+            f"flightrec: captured design {design_idx} "
+            f"({trigger}, {status_name(status)}) -> {path}")
+        return path
+
+
+# ---------------------------------------------------------------------------
+# load / replay
+# ---------------------------------------------------------------------------
+
+
+def load_bundle(path):
+    """Read a replay bundle -> (meta dict, dict of recorded arrays)."""
+    with open(os.path.join(path, META_NAME)) as f:
+        meta = json.load(f)
+    if meta.get("kind") != "raft_tpu.flightrec.bundle":
+        raise ValueError(f"{path!r} is not a flight-recorder bundle")
+    arrays = {}
+    npz = os.path.join(path, ARRAYS_NAME)
+    if os.path.exists(npz):
+        with np.load(npz, allow_pickle=False) as dat:
+            arrays = {k: np.array(dat[k]) for k in dat.files}
+    return meta, arrays
+
+
+def _compare_array(recorded, replayed):
+    recorded = np.asarray(recorded)
+    replayed = np.asarray(replayed)
+    if recorded.shape != replayed.shape:
+        return "shape-mismatch"
+    if recorded.dtype.kind in "fc" or replayed.dtype.kind in "fc":
+        if np.array_equal(recorded.astype(replayed.dtype), replayed,
+                          equal_nan=True):
+            return "bit-identical"
+        if np.allclose(recorded, replayed, rtol=1e-6, atol=0.0,
+                       equal_nan=True):
+            return "close"
+        return "mismatch"
+    return ("bit-identical" if np.array_equal(recorded, replayed)
+            else "mismatch")
+
+
+def replay(path, *, display=0):
+    """Re-run a bundle's design standalone and diff against the record.
+
+    The design re-enters ``sweep()`` through the batched path with
+    ``axes=[]`` — the same traced programs that produced the capture —
+    at design extent 1.  XLA:CPU codegen is batch-extent-sensitive in
+    the last bits, so a bundle captured from a wider chunk may compare
+    ``"close"`` rather than ``"bit-identical"``; the status
+    classification and health comparison are tolerance-based and do not
+    depend on those bits.
+
+    Returns a report dict: ``status`` {recorded, replayed, match},
+    ``arrays`` {name: verdict}, and ``ok`` (status matches and no array
+    verdict is "mismatch"/"shape-mismatch").
+    """
+    meta, arrays = load_bundle(path)
+    from ..sweep import sweep
+
+    want_trace = "resid_trace" in arrays
+    out = sweep(
+        meta["design"], [], [tuple(s) for s in meta["sea_states"]],
+        n_iter=meta["n_iter"], chunk_size=meta["chunk_size"],
+        wind=meta["wind"], display=display, health=meta["health"],
+        flightrec=({"enabled": True, "convergence": True, "dir": None}
+                   if want_trace else False))
+
+    report = {
+        "bundle": os.path.abspath(path),
+        "design_index": meta["design_index"],
+        "trigger": meta["trigger"],
+        "status": {
+            "recorded": meta["status_name"],
+            "replayed": status_name(int(out["status"][0])),
+            "match": int(out["status"][0]) == int(meta["status"]),
+        },
+        "arrays": {},
+    }
+    replayed = {
+        "std": out["motion_std"][0],
+        "a_std": out["AxRNA_std"][0],
+    }
+    if want_trace and "convergence" in out:
+        replayed["resid_trace"] = out["convergence"]["resid_trace"][0]
+    for k in _RECORDED_KEYS:
+        if k in arrays and k in replayed:
+            report["arrays"][k] = _compare_array(arrays[k], replayed[k])
+    # per-case health leaves: the sweep result carries the per-design
+    # reduction only, so re-reduce the recorded per-case arrays the way
+    # _store_rows does and compare at the per-design level
+    if "health_resid" in arrays:
+        report["arrays"]["health.resid"] = _compare_array(
+            np.max(arrays["health_resid"]), out["health"]["resid"][0])
+    if "health_cond" in arrays:
+        report["arrays"]["health.cond"] = _compare_array(
+            np.min(arrays["health_cond"]), out["health"]["cond"][0])
+    quarantine_note = None
+    if meta["trigger"] == "quarantine" and not report["status"]["match"]:
+        # a quarantined design had no recorded outputs — its chunk kept
+        # raising.  A standalone replay that *succeeds* is itself the
+        # finding (the fault was load/transient), so report it rather
+        # than failing the comparison.
+        quarantine_note = ("design replayed standalone with status "
+                          f"{report['status']['replayed']!r}; the original "
+                          "run quarantined it (chunk kept raising)")
+        report["note"] = quarantine_note
+    report["ok"] = bool(
+        (report["status"]["match"] or quarantine_note is not None)
+        and not any(v in ("mismatch", "shape-mismatch")
+                    for v in report["arrays"].values()))
+    return report
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _list_bundles(root):
+    out = []
+    try:
+        names = sorted(os.listdir(root))
+    except OSError:
+        return out
+    for name in names:
+        if os.path.exists(os.path.join(root, name, META_NAME)):
+            out.append(os.path.join(root, name))
+    return out
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m raft_tpu.obs.flightrec",
+        description="Flight-recorder replay bundles: list, inspect, replay.")
+    sub = p.add_subparsers(dest="cmd", required=True)
+    lp = sub.add_parser("list", help="list bundles under a capture dir")
+    lp.add_argument("dir", nargs="?",
+                    default=os.environ.get("RAFT_TPU_FLIGHTREC") or ".")
+    sp = sub.add_parser("show", help="print a bundle's metadata")
+    sp.add_argument("bundle")
+    rp = sub.add_parser("replay",
+                        help="re-run a bundle's design and diff the record")
+    rp.add_argument("bundle")
+    rp.add_argument("--display", type=int, default=0)
+    rp.add_argument("--json", action="store_true",
+                    help="print the full report as JSON")
+    args = p.parse_args(argv)
+
+    if args.cmd == "list":
+        for path in _list_bundles(args.dir):
+            meta, arrays = load_bundle(path)
+            print(f"{path}  design={meta['design_index']} "
+                  f"trigger={meta['trigger']} status={meta['status_name']} "
+                  f"arrays={len(arrays)}")
+        return 0
+    if args.cmd == "show":
+        meta, arrays = load_bundle(args.bundle)
+        meta = dict(meta)
+        meta["arrays"] = {k: [list(v.shape), str(v.dtype)]
+                         for k, v in arrays.items()}
+        meta.pop("design", None)  # bulky; replay reads it from disk
+        print(json.dumps(meta, indent=1))
+        return 0
+
+    report = replay(args.bundle, display=args.display)
+    if args.json:
+        print(json.dumps(report, indent=1))
+    else:
+        st = report["status"]
+        print(f"replay {report['bundle']}")
+        print(f"  design {report['design_index']} "
+              f"(trigger={report['trigger']})")
+        print(f"  status: recorded={st['recorded']} "
+              f"replayed={st['replayed']} "
+              f"{'MATCH' if st['match'] else 'DIFFERENT'}")
+        for k, v in report["arrays"].items():
+            print(f"  {k}: {v}")
+        if report.get("note"):
+            print(f"  note: {report['note']}")
+        print("  ok" if report["ok"] else "  MISMATCH")
+    return 0 if report["ok"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

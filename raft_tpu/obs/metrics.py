@@ -307,6 +307,14 @@ _STAGE_BUCKETS = (0.001, 0.005, 0.02, 0.05, 0.1, 0.25, 0.5, 1.0, 2.5,
 _COMPILE_BUCKETS = (0.01, 0.05, 0.1, 0.5, 1.0, 2.5, 5.0, 10.0, 30.0,
                     60.0, 120.0, 300.0)
 
+# flight-recorder convergence buckets: Borgman iteration counts run
+# 1..n_iter (n_iter+1 = never reached tolerance), residuals are
+# relative Frobenius norms spanning machine-precision to diverged
+_ITER_BUCKETS = (1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 8.0, 10.0, 12.0, 15.0,
+                 20.0, 25.0, 30.0)
+_RESID_BUCKETS = (1e-12, 1e-10, 1e-8, 1e-7, 1e-6, 1e-5, 1e-4, 1e-3,
+                  1e-2, 1e-1, 1.0)
+
 # chunk-loop profiling leaves whose durations become the stage-latency
 # histogram (the full phase name is "sweep/chunks/<stage>" on the main
 # thread, "checkpoint_write" / "compile/<key>" on workers)
@@ -395,6 +403,21 @@ class _Std:
             _STAGE_BUCKETS)
         self.warnings = c(
             "raft_warnings_total", "Warnings routed through obs.log")
+        self.convergence_iterations = h(
+            "raft_convergence_iterations",
+            "Borgman iterations to reach resid_tol per design (worst "
+            "over cases; n_iter+1 = never reached)", _ITER_BUCKETS)
+        self.final_residual = h(
+            "raft_final_residual",
+            "Final Borgman residual per design (worst over cases)",
+            _RESID_BUCKETS)
+        self.capability_fallbacks = c(
+            "raft_capability_fallbacks_total",
+            "Sweeps degraded to a less-capable execution path",
+            ("reason",))
+        self.replay_bundles = c(
+            "raft_replay_bundles_total",
+            "Flight-recorder replay bundles written")
 
 
 _STD = None
@@ -604,6 +627,19 @@ def _observe(event, rec):
         with _STATE_LOCK:
             if _ACTIVE is not None and isinstance(rec.get("counts"), dict):
                 _ACTIVE["health_counts"] = dict(rec["counts"])
+    elif event == "convergence_summary":
+        for it in rec.get("iters") or ():
+            if isinstance(it, (int, float)):
+                m.convergence_iterations.observe(float(it))
+        for r in rec.get("final_resid") or ():
+            # non-finite residuals travel as None (JSON); the status
+            # counters already account those designs
+            if isinstance(r, (int, float)):
+                m.final_residual.observe(float(r))
+    elif event == "capability_fallback":
+        m.capability_fallbacks.inc(reason=rec.get("reason", "?"))
+    elif event == "replay_bundle":
+        m.replay_bundles.inc()
     elif event == "warning":
         m.warnings.inc()
     elif event == "run_end":

@@ -204,10 +204,66 @@ def render(events):
                          f"{sum(eta_errs) / len(eta_errs):.3f} s over "
                          f"{len(eta_errs)} mid-run estimate(s)")
 
+    # ---- per-device view -------------------------------------------------
+    per_dev_bytes: dict = {}
+    for ev in fetches:
+        for d, b in (ev.get("per_device") or {}).items():
+            per_dev_bytes[int(d)] = per_dev_bytes.get(int(d), 0) + int(b)
+    for ev in transfers:
+        for d, b in (ev.get("per_device") or {}).items():
+            per_dev_bytes.setdefault(int(d), per_dev_bytes.get(int(d), 0))
+    mesh_shape = plan.get("mesh")
+    if per_dev_bytes or mesh_shape:
+        lines += _section("per-device")
+        if mesh_shape:
+            lines.append(
+                f"mesh     {'x'.join(str(s) for s in mesh_shape)} "
+                f"(design x case), devices {plan.get('devices')}")
+        if dispatches:
+            lines.append(f"pipeline peak in-flight "
+                         f"{max(ev.get('in_flight', 1) for ev in dispatches)}"
+                         f" chunk(s)")
+        total = sum(per_dev_bytes.values())
+        for d in sorted(per_dev_bytes):
+            b = per_dev_bytes[d]
+            frac = b / total if total else 0.0
+            lines.append(f"  device {d}: {_fmt_bytes(b)} fetched "
+                         f"({frac:6.1%})  |{_bar(frac)}|")
+
+    # ---- convergence (flight recorder) -----------------------------------
+    conv = by.get("convergence_summary", [])
+    if conv:
+        lines += _section("convergence")
+        iters = [i for ev in conv for i in (ev.get("iters") or [])
+                 if isinstance(i, (int, float))]
+        resid = [r for ev in conv for r in (ev.get("final_resid") or [])
+                 if isinstance(r, (int, float))]
+        n_iter = max((ev.get("n_iter", 0) for ev in conv), default=0)
+        n_nc = sum(1 for i in iters if i > n_iter)
+        lines.append(
+            f"{len(iters)} design(s) traced over {len(conv)} chunk(s), "
+            f"budget {n_iter} iteration(s)")
+        if iters:
+            conv_iters = [i for i in iters if i <= n_iter] or [n_iter + 1]
+            lines.append(
+                f"iterations to tolerance: min {min(conv_iters)} / "
+                f"median {sorted(conv_iters)[len(conv_iters) // 2]} / "
+                f"max {max(conv_iters)}; {n_nc} design(s) never reached "
+                "tolerance")
+        if resid:
+            lines.append(f"final residual: best {min(resid):.3e}, "
+                         f"worst {max(resid):.3e}")
+        n_nonfin = sum(1 for ev in conv for r in (ev.get("final_resid") or [])
+                       if r is None)
+        if n_nonfin:
+            lines.append(f"{n_nonfin} design(s) ended with a non-finite "
+                         "residual")
+
     # ---- quarantine / health timeline -----------------------------------
     fault_events = []
     for name in ("chunk_fault", "quarantine_retry", "quarantine_bisect",
-                 "design_quarantined", "status_transition", "warning"):
+                 "design_quarantined", "status_transition", "warning",
+                 "capability_fallback", "replay_bundle"):
         fault_events += by.get(name, [])
     fault_events.sort(key=lambda ev: ev.get("seq", 0))
     health = (by.get("health_report") or [{}])[-1]
@@ -224,6 +280,15 @@ def render(events):
                 "status_transition": lambda e: f"designs {e.get('designs')} "
                                                f"-> {e.get('to')}",
                 "warning": lambda e: f"warning: {e.get('message')}",
+                "capability_fallback": lambda e: (
+                    f"capability fallback ({e.get('reason')}): "
+                    f"{e.get('detail')}"
+                    + (f"; DROPS {', '.join(e.get('dropped'))}"
+                       if e.get("dropped") else "")),
+                "replay_bundle": lambda e: (
+                    f"replay bundle for design {e.get('design')} "
+                    f"({e.get('trigger')}, {e.get('status')}) -> "
+                    f"{e.get('path')}"),
             }[ev["event"]](ev)
             lines.append(f"  t+{ev.get('t', t0) - t0:8.3f}s  {what}")
         if health.get("counts"):

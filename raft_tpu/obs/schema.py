@@ -78,6 +78,17 @@ EVENTS: dict[str, tuple] = {
     "design_quarantined": ("designs",),         # + error
     "status_transition": ("designs", "to"),
     "health_report": ("counts",),               # + all_ok, quarantined
+    # -- flight recorder (raft_tpu.obs.flightrec) -------------------------
+    "convergence_summary": ("chunk", "n_iter", "iters", "final_resid"),
+                                                # per-chunk worst-over-cases
+                                                #   iterations-to-tolerance
+                                                #   + final residual, one
+                                                #   entry per real design
+    "capability_fallback": ("reason",),         # sweep degraded to the
+                                                #   per-variant path;
+                                                #   + detail, dropped
+    "replay_bundle": ("design", "path"),        # capture written; + trigger,
+                                                #   status
     # -- persistence / phases / traces ------------------------------------
     "checkpoint_flush": ("seconds", "ok"),
     "phase": ("name", "seconds"),               # streamed per phase exit

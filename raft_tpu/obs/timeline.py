@@ -1,0 +1,369 @@
+"""Run-ledger -> Chrome trace-event timeline export, with straggler report.
+
+The run ledger (:mod:`raft_tpu.obs.ledger`) is an append-only event
+log — great for grepping, bad for *seeing* a pipelined sweep: did the
+background compiles actually overlap host setup, how deep did the chunk
+pipeline run, which shard dragged every fetch.  This module converts
+one run's ledger file into Chrome trace-event JSON (the
+``chrome://tracing`` / Perfetto format: a ``traceEvents`` list of
+``"X"`` complete spans, ``"i"`` instants, and ``"M"`` metadata records
+with microsecond timestamps), laying the run out on four tracks:
+
+* **host** — per-phase spans (``phase`` events carry their duration),
+  plus a fetch->commit span per chunk;
+* **devices** — one thread per mesh device: each chunk's
+  dispatch->fetch window as a span on every device that executed it,
+  with real-row counts, in-flight depth, and per-shard fetch bytes;
+* **compile-service** — one span per executable build (``compile_end``
+  carries the build seconds), submitted/start instants;
+* **checkpoint-writer** — background flush spans.
+
+Faults, quarantine activity, status transitions, capability fallbacks,
+and replay-bundle captures appear as instants on the host track.
+
+The straggler report aggregates the same per-device evidence the PR-7
+``chunk_fetch.per_device`` byte splits record: per-device total bytes
+and share-of-fetch, plus the slowest chunks by dispatch->fetch wall
+time — the "which shard is dragging" question answered from the ledger
+alone, no profiler attach needed.
+
+CLI::
+
+    python -m raft_tpu.obs.timeline <ledger-file-or-dir> [-o trace.json]
+        [--stragglers] [--validate]
+"""
+
+from __future__ import annotations
+
+import json
+import os
+
+from . import ledger as obs_ledger
+from . import log as obs_log
+
+__all__ = ["build_trace", "validate_trace", "straggler_report",
+           "format_stragglers", "main"]
+
+_LOG = obs_log.get_logger("obs.timeline")
+
+PID_HOST = 1
+PID_DEVICES = 2
+PID_COMPILE = 3
+PID_CKPT = 4
+
+# host-track instants: event name -> display name
+_INSTANTS = {
+    "chunk_fault": "fault",
+    "quarantine_retry": "quarantine retry",
+    "quarantine_bisect": "quarantine bisect",
+    "design_quarantined": "quarantined",
+    "status_transition": "status",
+    "capability_fallback": "capability fallback",
+    "replay_bundle": "replay bundle",
+    "warning": "warning",
+    "exec_cache_hit": "exec-cache hit",
+    "exec_cache_miss": "exec-cache miss",
+    "exec_cache_reject": "exec-cache reject",
+}
+
+
+def _meta(pid, name, tid=None):
+    ev = {"ph": "M", "pid": pid, "ts": 0,
+          "name": "process_name" if tid is None else "thread_name",
+          "args": {"name": name}}
+    if tid is not None:
+        ev["tid"] = tid
+    return ev
+
+
+def _span(name, cat, ts_us, dur_us, pid, tid, args=None):
+    ev = {"ph": "X", "name": name, "cat": cat, "ts": ts_us,
+          "dur": max(0.0, dur_us), "pid": pid, "tid": tid}
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _instant(name, cat, ts_us, pid, tid, args=None):
+    ev = {"ph": "i", "name": name, "cat": cat, "ts": ts_us,
+          "pid": pid, "tid": tid, "s": "t"}
+    if args:
+        ev["args"] = args
+    return ev
+
+
+def _clean_args(rec, drop=("t", "seq", "event")):
+    return {k: v for k, v in rec.items() if k not in drop}
+
+
+def build_trace(events):
+    """Ledger event dicts (one run) -> Chrome trace-event dict.
+
+    Timestamps are microseconds relative to the run's first event, so
+    the timeline always starts at 0 regardless of wall-clock epoch.
+    """
+    if not events:
+        return {"traceEvents": [], "displayTimeUnit": "ms"}
+    t0 = min(ev["t"] for ev in events if isinstance(ev.get("t"), (int, float)))
+
+    def us(t):
+        return (t - t0) * 1e6
+
+    out = [
+        _meta(PID_HOST, "host"),
+        _meta(PID_COMPILE, "compile-service"),
+        _meta(PID_CKPT, "checkpoint-writer"),
+        _meta(PID_HOST, "phases", tid=0),
+        _meta(PID_HOST, "chunks", tid=1),
+        _meta(PID_HOST, "events", tid=2),
+    ]
+    device_tids: set = set()
+    compile_tid: dict = {}
+    dispatch: dict = {}   # chunk -> dispatch event
+    fetch: dict = {}      # chunk -> fetch event
+
+    for ev in events:
+        name = ev.get("event")
+        t = ev.get("t")
+        if not isinstance(t, (int, float)):
+            continue
+        ts = us(t)
+
+        if name == "phase":
+            dur = float(ev.get("seconds", 0.0)) * 1e6
+            out.append(_span(str(ev.get("name", "?")), "phase", ts - dur,
+                             dur, PID_HOST, 0))
+        elif name in ("template_build", "stack_build"):
+            secs = ev.get("seconds")
+            if isinstance(secs, (int, float)) and secs > 0:
+                out.append(_span(f"{name} ({ev.get('cache')})", "build",
+                                 ts - secs * 1e6, secs * 1e6, PID_HOST, 0))
+            else:
+                out.append(_instant(f"{name} ({ev.get('cache')})", "build",
+                                    ts, PID_HOST, 2))
+        elif name == "chunk_dispatch":
+            dispatch[ev.get("chunk")] = ev
+            for d in ev.get("devices") or ():
+                device_tids.add(int(d))
+        elif name == "chunk_fetch":
+            fetch[ev.get("chunk")] = ev
+            disp = dispatch.get(ev.get("chunk"))
+            if disp is not None:
+                d_ts = us(disp["t"])
+                per_dev = ev.get("per_device") or {}
+                devs = [int(d) for d in disp.get("devices") or ()] \
+                    or sorted(int(k) for k in per_dev) or [0]
+                for d in devs:
+                    device_tids.add(d)
+                    args = {"n_real": disp.get("n_real"),
+                            "in_flight": disp.get("in_flight"),
+                            "start": disp.get("start"),
+                            "stop": disp.get("stop")}
+                    db = per_dev.get(str(d), per_dev.get(d))
+                    if db is not None:
+                        args["fetch_bytes"] = db
+                    out.append(_span(f"chunk {ev.get('chunk')}", "chunk",
+                                     d_ts, ts - d_ts, PID_DEVICES, d, args))
+        elif name == "chunk_commit":
+            f_ev = fetch.get(ev.get("chunk"))
+            f_ts = us(f_ev["t"]) if f_ev is not None else ts
+            out.append(_span(f"commit {ev.get('chunk')}", "commit", f_ts,
+                             ts - f_ts, PID_HOST, 1,
+                             {"done": ev.get("done"),
+                              "eta_s": ev.get("eta_s")}))
+        elif name in ("compile_submitted", "compile_start"):
+            key = str(ev.get("key"))
+            tid = compile_tid.setdefault(key, len(compile_tid))
+            out.append(_instant(f"{name.split('_', 1)[1]} {key}", "compile",
+                                ts, PID_COMPILE, tid))
+        elif name == "compile_end":
+            key = str(ev.get("key"))
+            tid = compile_tid.setdefault(key, len(compile_tid))
+            secs = ev.get("seconds")
+            dur = float(secs) * 1e6 if isinstance(secs, (int, float)) else 0.0
+            out.append(_span(f"compile {key}", "compile", ts - dur, dur,
+                             PID_COMPILE, tid,
+                             {"cache": ev.get("cache"),
+                              "source": ev.get("source"),
+                              "xla_compiles": ev.get("xla_compiles")}))
+        elif name == "checkpoint_flush":
+            secs = float(ev.get("seconds", 0.0))
+            out.append(_span("flush", "checkpoint", ts - secs * 1e6,
+                             secs * 1e6, PID_CKPT, 0,
+                             {"ok": ev.get("ok")}))
+        elif name == "transfer":
+            out.append(_instant(
+                f"transfer {ev.get('direction')} {ev.get('what')}", "xfer",
+                ts, PID_HOST, 2, {"bytes": ev.get("bytes")}))
+        elif name in _INSTANTS:
+            out.append(_instant(_INSTANTS[name], "event", ts, PID_HOST, 2,
+                                _clean_args(ev)))
+        elif name in ("run_start", "run_end", "plan", "compile_overlap",
+                      "compile_cache", "convergence_summary",
+                      "health_report"):
+            out.append(_instant(name, "run", ts, PID_HOST, 2,
+                                _clean_args(ev)))
+        # device_memory / phase_stats / trace_capture and unknown events
+        # are deliberately not drawn — aggregates, not timeline points
+
+    out.append(_meta(PID_DEVICES, "devices"))
+    for d in sorted(device_tids):
+        out.append(_meta(PID_DEVICES, f"device {d}", tid=d))
+    for key, tid in compile_tid.items():
+        out.append(_meta(PID_COMPILE, f"build {key}", tid=tid))
+
+    return {"traceEvents": out, "displayTimeUnit": "ms"}
+
+
+def validate_trace(trace):
+    """Error strings for a trace dict (empty = valid trace-event JSON)."""
+    errors = []
+    if not isinstance(trace, dict) or "traceEvents" not in trace:
+        return ["missing traceEvents"]
+    if not isinstance(trace["traceEvents"], list):
+        return ["traceEvents is not a list"]
+    for i, ev in enumerate(trace["traceEvents"]):
+        if not isinstance(ev, dict):
+            errors.append(f"event {i}: not an object")
+            continue
+        ph = ev.get("ph")
+        if ph not in ("X", "i", "M", "B", "E", "C"):
+            errors.append(f"event {i}: bad ph {ph!r}")
+            continue
+        for f in ("pid", "ts"):
+            if not isinstance(ev.get(f), (int, float)):
+                errors.append(f"event {i}: {f} not a number")
+        if not isinstance(ev.get("name"), str):
+            errors.append(f"event {i}: missing name")
+        if ph == "X" and not isinstance(ev.get("dur"), (int, float)):
+            errors.append(f"event {i}: X span without dur")
+        if ph == "X" and isinstance(ev.get("dur"), (int, float)) \
+                and ev["dur"] < 0:
+            errors.append(f"event {i}: negative dur")
+        if ph == "i" and ev.get("s") not in (None, "t", "p", "g"):
+            errors.append(f"event {i}: bad instant scope {ev.get('s')!r}")
+    return errors
+
+
+# ---------------------------------------------------------------------------
+# straggler report
+# ---------------------------------------------------------------------------
+
+
+def straggler_report(events, top=5):
+    """Per-device imbalance evidence from one run's chunk events.
+
+    Returns a dict: ``devices`` {id: {bytes, share}}, ``chunks`` (the
+    ``top`` slowest by dispatch->fetch wall seconds, each with its
+    per-device byte split), and ``imbalance`` (max device share over
+    mean share; 1.0 = perfectly balanced fetches).
+    """
+    dispatch = {}
+    per_dev_total: dict = {}
+    chunk_walls = []
+    for ev in events:
+        name = ev.get("event")
+        if name == "chunk_dispatch":
+            dispatch[ev.get("chunk")] = ev
+        elif name == "chunk_fetch":
+            disp = dispatch.get(ev.get("chunk"))
+            per_dev = {int(k): int(v)
+                       for k, v in (ev.get("per_device") or {}).items()}
+            for d, b in per_dev.items():
+                per_dev_total[d] = per_dev_total.get(d, 0) + b
+            if disp is not None:
+                chunk_walls.append({
+                    "chunk": ev.get("chunk"),
+                    "wall_s": float(ev["t"]) - float(disp["t"]),
+                    "n_real": disp.get("n_real"),
+                    "per_device": per_dev,
+                })
+    total = sum(per_dev_total.values())
+    devices = {
+        d: {"bytes": b, "share": (b / total if total else 0.0)}
+        for d, b in sorted(per_dev_total.items())
+    }
+    shares = [v["share"] for v in devices.values()]
+    imbalance = (max(shares) / (sum(shares) / len(shares))
+                 if shares and sum(shares) else 1.0)
+    chunk_walls.sort(key=lambda c: -c["wall_s"])
+    return {"devices": devices, "chunks": chunk_walls[:top],
+            "imbalance": imbalance}
+
+
+def format_stragglers(report):
+    lines = ["straggler report"]
+    if not report["devices"]:
+        lines.append("  (no per-device chunk_fetch data in this ledger)")
+    for d, v in report["devices"].items():
+        lines.append(f"  device {d}: {v['bytes']:>12,} B fetched "
+                     f"({v['share']:6.1%})")
+    if report["devices"]:
+        lines.append(f"  fetch imbalance (max/mean share): "
+                     f"{report['imbalance']:.3f}")
+    if report["chunks"]:
+        lines.append("  slowest chunks (dispatch->fetch):")
+        for c in report["chunks"]:
+            lines.append(f"    chunk {c['chunk']}: {c['wall_s']*1e3:8.1f} ms "
+                         f"({c['n_real']} designs)")
+    return "\n".join(lines)
+
+
+# ---------------------------------------------------------------------------
+# CLI
+# ---------------------------------------------------------------------------
+
+
+def _resolve_ledger(path):
+    if os.path.isdir(path):
+        runs = obs_ledger.list_runs(path)
+        if not runs:
+            raise SystemExit(f"no ledger files under {path!r}")
+        return runs[-1]
+    return path
+
+
+def main(argv=None):
+    import argparse
+
+    p = argparse.ArgumentParser(
+        prog="python -m raft_tpu.obs.timeline",
+        description="Export a run ledger as Chrome trace-event JSON "
+                    "(load in chrome://tracing or ui.perfetto.dev).")
+    p.add_argument("ledger",
+                   help="ledger .jsonl file, or a ledger dir (latest run)")
+    p.add_argument("-o", "--out", default=None,
+                   help="output path (default: <ledger>.trace.json)")
+    p.add_argument("--stragglers", action="store_true",
+                   help="print the per-device straggler report")
+    p.add_argument("--validate", action="store_true",
+                   help="schema-check the emitted trace and exit nonzero "
+                        "on errors")
+    args = p.parse_args(argv)
+
+    path = _resolve_ledger(args.ledger)
+    events = obs_ledger.read_events(path)
+    trace = build_trace(events)
+    out_path = args.out or (os.path.splitext(path)[0] + ".trace.json")
+    with open(out_path, "w") as f:
+        json.dump(trace, f)
+    n_spans = sum(1 for e in trace["traceEvents"] if e.get("ph") == "X")
+    print(f"wrote {out_path}: {len(trace['traceEvents'])} events "
+          f"({n_spans} spans) from {os.path.basename(path)}")
+
+    status = 0
+    if args.validate:
+        errors = validate_trace(trace)
+        for e in errors[:20]:
+            print(f"invalid: {e}")
+        if errors:
+            status = 1
+        else:
+            print("trace valid")
+    if args.stragglers:
+        print(format_stragglers(straggler_report(events)))
+    return status
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -140,7 +140,8 @@ def design_params(fowt, include_aero=True, device=None):
 
 
 def make_parametric_solver(static, n_iter=15, with_health=False,
-                           tik_eps=1e-6, tik_cond_tol=1e-12):
+                           tik_eps=1e-6, tik_cond_tol=1e-12,
+                           resid_trace=False):
     """Pure function solve(params, zeta, beta[, aero]) -> Xi [nH,6,nw].
 
     ``static`` is the second return of :func:`design_params` (python
@@ -167,7 +168,18 @@ def make_parametric_solver(static, n_iter=15, with_health=False,
     case) axes and add no program beyond the one jit that carries them
     (see :mod:`raft_tpu.robust.health`).  The ``with_health=False``
     trace is bit-identical to the seed solver.
+
+    ``resid_trace`` (requires ``with_health``) additionally returns the
+    full per-iteration Borgman residual trajectory as the scan's
+    stacked ys — ``(Xi, SolveHealth, trace[n_iter])`` with
+    ``trace.dtype == w.dtype`` — at zero extra solves: the residual is
+    already computed in the scan carry, emitting it as ys only adds
+    the ``[n_iter]`` output buffer.  Off, the returned pytree and the
+    traced program are exactly the ``with_health`` ones (the flight
+    recorder's sentinel-pinned off-path contract).
     """
+    if resid_trace and not with_health:
+        raise ValueError("resid_trace requires with_health=True")
     nw = static["nw"]
     depth = static["depth"]
     rho = static["rho"]
@@ -331,12 +343,13 @@ def make_parametric_solver(static, n_iter=15, with_health=False,
             bad_lane = jnp.any(~jnp.isfinite(Xi_new), axis=0)  # [nw]
             Xi_safe = jnp.where(bad_lane[None, :], Xi_last, Xi_new)
             resid = fnorm(Xi_safe - Xi_last) / (fnorm(Xi_safe) + tiny)
-            return (Xi_safe, resid.astype(real_dt),
-                    bad_any | jnp.any(bad_lane)), None
+            resid = resid.astype(real_dt)
+            return (Xi_safe, resid, bad_any | jnp.any(bad_lane)), (
+                resid if resid_trace else None)
 
         carry0 = (Xi0, jnp.asarray(jnp.inf, dtype=real_dt),
                   jnp.asarray(False))
-        (Xi_relaxed, resid, scan_bad), _ = jax.lax.scan(
+        (Xi_relaxed, resid, scan_bad), trace = jax.lax.scan(
             body_h, carry0, None, length=n_iter)
 
         B6, Bmat = drag_terms(Xi_relaxed)
@@ -364,6 +377,8 @@ def make_parametric_solver(static, n_iter=15, with_health=False,
             nonfinite=scan_bad | jnp.any(~jnp.isfinite(Xi_raw)),
             n_fallback=jnp.sum(bad_lane).astype(jnp.int32),
         )
+        if resid_trace:
+            return Xi_out, health, trace
         return Xi_out, health
 
     return solve

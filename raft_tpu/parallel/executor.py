@@ -212,7 +212,10 @@ def start_host_fetch(tree):
     next chunk's execution, and the commit-side ``np.asarray`` calls
     find the bytes already on the host instead of paying a synchronous
     round trip each.  Non-jax leaves (a fault-injection hook returning
-    numpy rows) pass through untouched.  Returns ``tree`` unchanged.
+    numpy rows) pass through untouched, and ``None`` members — e.g. the
+    health block with ``health=False``, or the flight recorder's
+    residual-trace slot when telemetry is off — are dropped by
+    ``tree_leaves`` rather than fetched.  Returns ``tree`` unchanged.
     """
     for leaf in jax.tree_util.tree_leaves(tree):
         fetch = getattr(leaf, "copy_to_host_async", None)

@@ -27,6 +27,7 @@ from .health import (  # noqa: F401
     STATUS_QUARANTINED,
     SolveHealth,
     classify_health,
+    iterations_to_tolerance,
     status_name,
 )
 from .quarantine import run_isolated  # noqa: F401

@@ -31,6 +31,7 @@ __all__ = [
     "STATUS_OK", "STATUS_NONCONV", "STATUS_ILLCOND", "STATUS_NAN",
     "STATUS_QUARANTINED", "STATUS_NAMES",
     "classify_health", "status_name", "reduce_design_status",
+    "iterations_to_tolerance",
 ]
 
 
@@ -112,3 +113,22 @@ def classify_health(health, resid_tol, cond_tol):
 def reduce_design_status(status_per_case):
     """[..., n_case] per-case statuses -> per-design worst status."""
     return np.max(np.asarray(status_per_case, dtype=np.int8), axis=-1)
+
+
+def iterations_to_tolerance(resid_trace, resid_tol):
+    """First Borgman iteration (1-based) whose residual is within
+    tolerance, from a ``[..., n_iter]`` per-iteration residual trace
+    (the flight recorder's ``lax.scan`` ys).
+
+    Returns int32 of shape ``resid_trace.shape[:-1]``; a trajectory
+    that never reaches ``resid_tol`` (including one that went
+    non-finite) reports ``n_iter + 1`` — a sortable "did not converge"
+    sentinel that keeps the iteration histogram well-defined.  Host-side
+    numpy, like :func:`classify_health`: tolerances never enter a trace.
+    """
+    trace = np.asarray(resid_trace)
+    n_iter = trace.shape[-1]
+    hit = np.isfinite(trace) & (trace <= resid_tol)
+    first = np.argmax(hit, axis=-1).astype(np.int32)  # 0 when no hit
+    return np.where(np.any(hit, axis=-1), first + 1,
+                    np.int32(n_iter + 1)).astype(np.int32)

@@ -52,7 +52,8 @@ def _merge(parts, idx_parts, n_rows):
     return out
 
 
-def run_isolated(run, idx, retries=1, display=0, align=1):
+def run_isolated(run, idx, retries=1, display=0, align=1,
+                 on_quarantine=None):
     """Execute ``run(idx)`` with fault isolation.
 
     Parameters
@@ -79,6 +80,12 @@ def run_isolated(run, idx, retries=1, display=0, align=1):
         its mesh's design-axis extent, so each half's real rows occupy
         whole shard rows of the padded chunk executables).  ``align=1``
         (the default) is the exact historical plain bisection.
+    on_quarantine : callable(int, Exception) | None
+        Invoked once per design at the moment bisection gives it up
+        (the ``n == 1`` dead end), with the design index and the final
+        exception — the flight recorder's capture hook.  The callback
+        runs inside its own ``try``: a failing observer can never
+        change what gets quarantined.
 
     Returns
     -------
@@ -95,10 +102,11 @@ def run_isolated(run, idx, retries=1, display=0, align=1):
 
     with profiling.phase("isolate"):
         return _run_isolated(run, idx, retries=retries, display=display,
-                             align=align)
+                             align=align, on_quarantine=on_quarantine)
 
 
-def _run_isolated(run, idx, retries=1, display=0, align=1, _depth=0):
+def _run_isolated(run, idx, retries=1, display=0, align=1,
+                  on_quarantine=None, _depth=0):
     idx = np.asarray(idx)
     n = len(idx)
     last_err = None
@@ -120,6 +128,15 @@ def _run_isolated(run, idx, retries=1, display=0, align=1, _depth=0):
             f"sweep: design index {int(idx[0])} quarantined after "
             f"{type(last_err).__name__}: {last_err}",
             RuntimeWarning, stacklevel=2)
+        if on_quarantine is not None:
+            try:
+                on_quarantine(int(idx[0]), last_err)
+            except Exception as cb_err:  # noqa: BLE001 - observer only
+                obs_log.warn(
+                    _LOG,
+                    "sweep: flight-recorder capture failed for design "
+                    f"{int(idx[0])}: {type(cb_err).__name__}: {cb_err}",
+                    RuntimeWarning, stacklevel=2)
         return None, np.ones(1, dtype=bool)
 
     obs_ledger.emit("quarantine_bisect", n=int(n))
@@ -135,7 +152,8 @@ def _run_isolated(run, idx, retries=1, display=0, align=1, _depth=0):
     parts, masks = [], []
     for half in halves:
         res, mask = _run_isolated(run, half, retries=0, display=display,
-                                  align=align, _depth=_depth + 1)
+                                  align=align, on_quarantine=on_quarantine,
+                                  _depth=_depth + 1)
         parts.append(res)
         masks.append(mask)
     quarantined = np.concatenate(masks)

@@ -34,7 +34,8 @@ import jax.numpy as jnp
 
 from . import profiling
 from .analysis.contracts import shape_contract
-from .config import executor_config, health_config, resolve_mesh_devices
+from .config import (executor_config, flightrec_config, health_config,
+                     resolve_mesh_devices)
 from .core.model import Model
 from .obs import ledger as obs_ledger
 from .obs import log as obs_log
@@ -50,7 +51,8 @@ from .parallel.executor import (CheckpointWriter, FaultIsolator,
 from .robust import (STATUS_NAN, STATUS_OK, STATUS_QUARANTINED, SolveHealth,
                      build_report, classify_health, format_report,
                      run_isolated)
-from .robust.health import STATUS_NAMES, reduce_design_status
+from .robust.health import (STATUS_NAMES, iterations_to_tolerance,
+                            reduce_design_status)
 
 __all__ = ["sweep", "precompile", "set_in_design", "case_aero_params"]
 
@@ -240,7 +242,7 @@ def _sweep_signature(base_design, axes, combos, sea_states, n_iter, wind):
 
 def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
           checkpoint=None, chunk_size=256, wind=None, devices=None,
-          health=None):
+          health=None, flightrec=None):
     """Run a factorial design sweep.
 
     Parameters
@@ -271,8 +273,12 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         WAVE-excitation-only with the aero-servo impedance (A_aero,
         B_aero + gyro) folded in at ptfm_pitch=0 — the wind-excitation
         forcing spectrum (f_aero) is not added to motion_std/AxRNA_std.
-        Use the full ``Model.analyzeCases`` path for combined wind+wave
-        response spectra.
+        This matches the reference's own behaviour: raft_model.py:895
+        zeroes f_aero before the solve and the rotor-excitation block
+        (raft_model.py:1086-1095) is commented out, so the reference
+        sweep's turbulent-wind excitation is equally disabled — wind
+        enters through the impedance (and mean loads) only, and this
+        sweep faithfully mirrors that.
     checkpoint : str, optional
         Path to an .npz progress file.  Designs execute in chunks of
         ``chunk_size``; after each chunk the partial results are saved
@@ -293,6 +299,20 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         ``cond_tol`` classify on the host and never recompile anything;
         ``tik_eps`` / ``tik_cond_tol`` are constants of the solver trace.
         See docs/robustness.md.
+    flightrec : bool or dict, optional
+        Flight-recorder configuration
+        (:data:`raft_tpu.config.FLIGHTREC_DEFAULTS`): ``None`` reads the
+        ``RAFT_TPU_FLIGHTREC*`` environment (off when unset), ``True``
+        turns on the in-graph per-iteration Borgman residual trace
+        (requires the health channel; adds a ``'convergence'`` entry to
+        the results and ``convergence_summary`` ledger events),
+        ``False`` forces everything off, a dict overrides individual
+        keys.  With a capture ``dir`` armed, quarantined designs (and
+        status transitions at/above the configured ``severity``) write
+        self-contained replay bundles — see
+        :mod:`raft_tpu.obs.flightrec` and docs/robustness.md.  Off (the
+        default) is the seed trace: bit-identical results, zero
+        additional XLA compiles.
 
     Returns
     -------
@@ -345,7 +365,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                           device=device, display=display,
                           checkpoint=checkpoint, chunk_size=chunk_size,
                           wind=wind, devices=devices, mesh_shape=mesh_shape,
-                          health=health, run=run)
+                          health=health, flightrec=flightrec, run=run)
         run.finish(ok=True, counts=out["report"]["counts"])
         return out
     except BaseException as e:
@@ -357,7 +377,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
 
 def precompile(base_design, axes, sea_states, n_iter=15, device=None,
                display=0, chunk_size=256, wind=None, devices=None,
-               health=None):
+               health=None, flightrec=None):
     """Warm up the sweep executables without dispatching any chunk.
 
     Runs :func:`sweep`'s plan phase exactly — template model, variant
@@ -404,8 +424,8 @@ def precompile(base_design, axes, sea_states, n_iter=15, device=None,
         out = _sweep_impl(base_design, axes, sea_states, n_iter=n_iter,
                           device=device, display=display, checkpoint=None,
                           chunk_size=chunk_size, wind=wind, devices=devices,
-                          mesh_shape=mesh_shape, health=health, run=run,
-                          compile_only=True)
+                          mesh_shape=mesh_shape, health=health,
+                          flightrec=flightrec, run=run, compile_only=True)
         run.finish(ok=True)
         return out
     except BaseException as e:
@@ -417,7 +437,7 @@ def precompile(base_design, axes, sea_states, n_iter=15, device=None,
 
 def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 checkpoint, chunk_size, wind, devices, health, run,
-                mesh_shape=None, compile_only=False):
+                flightrec=None, mesh_shape=None, compile_only=False):
     """:func:`sweep` body; ``run`` is the active ledger run (NULL_RUN
     when telemetry is off — every ``run.emit`` is then a no-op and all
     byte/stat collection is gated behind ``run.enabled``).
@@ -443,6 +463,22 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
     else:
         hcfg = health_config(dict(health))
     run_health = bool(hcfg["enabled"])
+
+    if flightrec is False:
+        fcfg = flightrec_config({"enabled": False})
+    elif flightrec is None:
+        fcfg = flightrec_config()
+    elif flightrec is True:
+        fcfg = flightrec_config({"enabled": True})
+    else:
+        fcfg = flightrec_config(dict(flightrec))
+    # the residual trace rides the health scan's carry as ys — no health
+    # channel, no trace (case_solve enforces the same invariant)
+    run_trace = bool(fcfg["enabled"] and fcfg["convergence"] and run_health)
+    # per-iteration Borgman residual trajectories, filled per chunk like
+    # the result arrays (NaN = never computed / fallback path row)
+    conv_trace = (np.full((n_designs, n_cases, int(n_iter)), np.nan)
+                  if run_trace else None)
 
     # the production path is ALWAYS the (design, case) mesh — a single
     # device is the degenerate 1x1 mesh of the same sharded code, not a
@@ -529,6 +565,12 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                "AxRNA_std": nacelle_acc, **props,
                "status": status,
                "health": {"resid": health_resid, "cond": health_cond}}
+        if conv_trace is not None:
+            out["convergence"] = {
+                "resid_trace": conv_trace,
+                "iters_to_tol": iterations_to_tolerance(
+                    conv_trace, hcfg["resid_tol"]),
+            }
         out["report"] = build_report(status, combos=combos, axes=axes,
                                      health=out["health"])
         if display:
@@ -609,6 +651,32 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 "wind-enabled sweeps need the batched design path; this "
                 f"axis set falls outside it ({e}). Sweep site/topology axes "
                 "without `wind`, or via the full Model per point.") from e
+        # the fallback is a capability DOWNGRADE, not just a slow path:
+        # its per-variant solve never runs calcBEM (core/fowt.py:353 —
+        # A_BEM/B_BEM stay zero) and has no F_BEM/QTF term, so
+        # potential-flow designs lose their BEM added mass/damping and
+        # second-order forces.  Record the degradation in the ledger
+        # (capability_fallback -> raft_capability_fallbacks_total) and,
+        # when forces are actually being dropped, warn loudly
+        # (-> raft_warnings_total) instead of proceeding silently.
+        dropped = []
+        if any(cm.topo.pot_mod for cm in fowt.memberList) \
+                or fowt.potModMaster in (2, 3) \
+                or getattr(fowt, "potFirstOrder", 0):
+            dropped.append("BEM added mass/damping (A_BEM/B_BEM)")
+        if getattr(fowt, "potSecOrder", 0):
+            dropped.append("second-order wave forces (QTF)")
+        run.emit("capability_fallback", reason="sweep_axis",
+                 detail=str(e), dropped=dropped)
+        if dropped:
+            obs_log.warn(
+                _LOG,
+                "sweep: per-variant fallback path DROPS "
+                + " and ".join(dropped)
+                + f" for this potential-flow design ({e}); results omit "
+                "those contributions — use the full Model.analyzeCases "
+                "path for potential-flow configurations",
+                RuntimeWarning, stacklevel=3)
         if display:
             obs_log.display(_LOG, f"sweep: falling back to per-variant model path ({e})")
 
@@ -667,6 +735,12 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         # the executable identity
         health_sig = ((True, hcfg["tik_eps"], hcfg["tik_cond_tol"])
                       if run_health else (False,))
+        if run_trace:
+            # the residual trace adds a scan output to the traced
+            # programs.  Extending the signature ONLY when tracing keeps
+            # every trace-off memo/exec-cache key byte-identical to the
+            # seed's — the zero-extra-compiles contract.
+            health_sig = health_sig + (True,)
         jit_key = (mode, place_sig, chunk_size, n_cases, len(av_combos),
                    health_sig)
         ecfg = executor_config()
@@ -734,7 +808,8 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             # unchanged — params is consumed on-device by B.
             solve_p = make_parametric_solver(
                 static, n_iter=n_iter, with_health=run_health,
-                tik_eps=hcfg["tik_eps"], tik_cond_tol=hcfg["tik_cond_tol"])
+                tik_eps=hcfg["tik_eps"], tik_cond_tol=hcfg["tik_cond_tol"],
+                resid_trace=run_trace)
             # nacelle positions for the acceleration channel (constant
             # across platform-geometry variants; per-variant along turbine
             # axes); the reported channel is the max over rotors, matching
@@ -757,10 +832,14 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                     treedef, unpack_leaves(packed, spec, n_leaves))
 
             def _postB(out, zh):
-                """Metrics (+ health) from the double-vmapped solve."""
+                """Metrics (+ health, + residual trace) from the
+                double-vmapped solve."""
                 if not run_health:
                     return _metrics(out, zh)
-                Xi, hb = out  # hb leaves: [chunk, ncase]
+                if run_trace:
+                    Xi, hb, tr = out  # tr: [chunk, ncase, n_iter]
+                else:
+                    Xi, hb = out  # hb leaves: [chunk, ncase]
                 std, a_std = _metrics(Xi, zh)
                 # escalate metric non-finiteness into the health flag so
                 # a status-ok row can never carry NaN
@@ -768,6 +847,8 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                     nonfinite=hb.nonfinite
                     | ~jnp.all(jnp.isfinite(std), axis=-1)
                     | ~jnp.isfinite(a_std))
+                if run_trace:
+                    return std, a_std, hb, tr
                 return std, a_std, hb
 
             if mode in ("sel", "sel_wind"):
@@ -850,6 +931,11 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             # a pytree prefix
             outB_spec = (pdc, pdc, pdc) if run_health else (pdc, pdc)
             outB_sh = (dc, dc, dc) if run_health else (dc, dc)
+            if run_trace:
+                # the [chunk, ncase, n_iter] residual trace shards like
+                # the metrics along its leading (design, case) axes
+                outB_spec = outB_spec + (pdc,)
+                outB_sh = outB_sh + (dc,)
             shB = shard_map(partB, mesh=mesh, in_specs=specB,
                             out_specs=outB_spec, check_rep=False)
             jB = jax.jit(shB, donate_argnums=(0,),
@@ -1146,6 +1232,20 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                         rcache.pop(next(iter(rcache)))
                     rcache[rkey] = resident
 
+        # flight-recorder anomaly capture: armed only with a bundle
+        # directory, and only on this batched path — a replay bundle
+        # re-runs its single design through sweep(design, axes=[], ...),
+        # which IS this path, so captures replay through the same traced
+        # programs that produced them
+        recorder = None
+        if fcfg["enabled"] and fcfg["dir"]:
+            from .obs.flightrec import Recorder
+            recorder = Recorder(
+                base_design=base_design, axes=axes, combos=combos,
+                sea_states=sea_states, wind=wind, n_iter=n_iter,
+                hcfg=hcfg, fcfg=fcfg, chunk_size=chunk_local, run=run,
+                stacked=stacked)
+
         # coalescing background checkpoint persistence: the chunk loop
         # submits state snapshots and never blocks on np.savez; close()
         # in the finally below guarantees the final (complete) state is
@@ -1234,14 +1334,21 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                             outB = cB(params, zetas, betas,
                                       {k: sel_variants[k] for k in ("A", "B", "zh")},
                                       av_dev)
-                if run_health:
+                tr = None
+                if run_trace:
+                    std, a_std, hb, tr = outB
+                elif run_health:
                     std, a_std, hb = outB
                 else:
                     (std, a_std), hb = outB, None
                 # kick off the device->host copies now: they overlap the
                 # next chunk's execution, and the commit-side np.asarray
-                # finds the bytes already on the host
-                return start_host_fetch((std, a_std, pr, hb))
+                # finds the bytes already on the host.  The dispatch
+                # tuple stays a 4-tuple whenever the trace is off so the
+                # _CHUNK_EXEC_HOOK test seam (and anything else unpacking
+                # it) sees the historical arity.
+                return start_host_fetch(
+                    (std, a_std, pr, hb) + ((tr,) if run_trace else ()))
 
             def _classify_rows(rows_idx, std_rows, a_std_rows, hb_rows):
                 """int8 per-design status for fetched numpy chunk rows."""
@@ -1258,7 +1365,8 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                     st, np.where(input_ok[rows_idx], np.int8(STATUS_OK),
                                  np.int8(STATUS_NAN)))
 
-            def _store_rows(rows_idx, std_rows, a_std_rows, pr_rows, hb_rows):
+            def _store_rows(rows_idx, std_rows, a_std_rows, pr_rows, hb_rows,
+                            tr_rows=None):
                 """Write fetched rows + their status into the result
                 arrays (rows_idx: absolute design indices)."""
                 results[rows_idx] = std_rows
@@ -1268,6 +1376,22 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 if hb_rows is not None:
                     health_resid[rows_idx] = np.max(hb_rows["resid"], axis=-1)
                     health_cond[rows_idx] = np.min(hb_rows["cond"], axis=-1)
+                if tr_rows is not None:
+                    conv_trace[rows_idx] = tr_rows
+                    if run.enabled:
+                        # worst-over-cases per design: one entry per row
+                        iters = np.max(iterations_to_tolerance(
+                            tr_rows, hcfg["resid_tol"]), axis=-1)
+                        final = np.max(tr_rows[..., -1], axis=-1)
+                        run.emit(
+                            "convergence_summary",
+                            chunk=int(rows_idx[0]) // chunk_size,
+                            n_iter=int(n_iter),
+                            designs=[int(i) for i in rows_idx],
+                            iters=[int(i) for i in iters],
+                            # JSON has no Inf/NaN: non-finite -> None
+                            final_resid=[float(r) if np.isfinite(r) else None
+                                         for r in final])
                 status[rows_idx] = _classify_rows(rows_idx, std_rows,
                                                   a_std_rows, hb_rows)
                 if run.enabled:
@@ -1279,17 +1403,31 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                                 designs=[int(i) for i
                                          in rows_idx[st_rows == code]],
                                 to=STATUS_NAMES.get(int(code), "?"))
+                if recorder is not None:
+                    st_rows = status[rows_idx]
+                    for j in np.flatnonzero(st_rows >= recorder.severity):
+                        rec = {"std": std_rows[j], "a_std": a_std_rows[j]}
+                        if hb_rows is not None:
+                            rec["health"] = {k: v[j]
+                                             for k, v in hb_rows.items()}
+                        if tr_rows is not None:
+                            rec["resid_trace"] = tr_rows[j]
+                        recorder.capture(int(rows_idx[j]), trigger="status",
+                                         status=int(st_rows[j]),
+                                         recorded=rec)
                 done[rows_idx] = True
                 if ckpt_writer is not None:
                     _submit_ckpt()
 
             def _commit(entry):
-                start, stop, n_real, std, a_std, pr, hb = entry
+                start, stop, n_real, std, a_std, pr, hb = entry[:7]
+                tr = entry[7] if len(entry) > 7 else None
                 with profiling.phase("fetch"):
                     hb_rows = None
                     if hb is not None:
                         hb_rows = {k: np.asarray(v)[:n_real]
                                    for k, v in hb._asdict().items()}
+                    tr_rows = np.asarray(tr)[:n_real] if tr is not None else None
                     std_rows = np.asarray(std)[:n_real]
                     a_std_rows = np.asarray(a_std)[:n_real]
                     pr_rows = {k: np.asarray(pr[k])[:n_real] for k in props}
@@ -1297,17 +1435,18 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                     nb = (std_rows.nbytes + a_std_rows.nbytes
                           + sum(v.nbytes for v in pr_rows.values())
                           + (sum(v.nbytes for v in hb_rows.values())
-                             if hb_rows is not None else 0))
+                             if hb_rows is not None else 0)
+                          + (tr_rows.nbytes if tr_rows is not None else 0))
                     # per-shard split of the device-side result buffers:
                     # each mesh member streamed its shard back
                     # independently (copy_to_host_async is per-shard)
-                    per_dev = obs_ledger.shard_bytes((std, a_std, pr, hb))
+                    per_dev = obs_ledger.shard_bytes((std, a_std, pr, hb, tr))
                     run.emit("chunk_fetch", chunk=start // chunk_size,
                              bytes=int(nb),
                              **({"per_device": per_dev} if per_dev else {}))
                 with profiling.phase("commit"):
                     _store_rows(np.arange(start, stop), std_rows, a_std_rows,
-                                pr_rows, hb_rows)
+                                pr_rows, hb_rows, tr_rows)
                 if run.enabled:
                     n_done = int(done.sum())
                     run.emit("chunk_commit", chunk=start // chunk_size,
@@ -1328,7 +1467,9 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 n_r = sub_idx.size
                 idx = np.full(chunk_size, sub_idx[-1], dtype=np.int64)
                 idx[:n_r] = sub_idx
-                std, a_std, pr, hb = _dispatch(idx)
+                out = _dispatch(idx)
+                std, a_std, pr, hb = out[:4]
+                tr = out[4] if len(out) > 4 else None
                 rows = {"std": np.asarray(std)[:n_r],
                         "a_std": np.asarray(a_std)[:n_r],
                         **{f"prop_{k}": np.asarray(pr[k])[:n_r]
@@ -1336,6 +1477,8 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 if hb is not None:
                     for k, v in hb._asdict().items():
                         rows[k] = np.asarray(v)[:n_r]
+                if tr is not None:
+                    rows["resid_trace"] = np.asarray(tr)[:n_r]
                 return rows
 
             isolator = FaultIsolator()
@@ -1366,19 +1509,27 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 # dispatch, so healthy rows recovered by bisection are
                 # bit-identical to an unfaulted run — and to the
                 # single-device bisection of the same fault
+                on_q = None
+                if recorder is not None:
+                    def on_q(design_idx, err):
+                        recorder.capture(design_idx, trigger="quarantine",
+                                         status=int(STATUS_QUARANTINED),
+                                         error=err)
                 merged, quarantined = run_isolated(
                     _exec_rows, rows_idx, retries=1, display=display,
-                    align=chunk_local)
+                    align=chunk_local, on_quarantine=on_q)
                 ok = ~quarantined
                 if merged is not None and ok.any():
                     hb_rows = None
                     if "resid" in merged:
                         hb_rows = {k: merged[k][ok] for k in
                                    ("resid", "cond", "nonfinite", "n_fallback")}
+                    tr_rows = (merged["resid_trace"][ok]
+                               if "resid_trace" in merged else None)
                     _store_rows(rows_idx[ok], merged["std"][ok],
                                 merged["a_std"][ok],
                                 {k: merged[f"prop_{k}"][ok] for k in props},
-                                hb_rows)
+                                hb_rows, tr_rows)
                 status[rows_idx[quarantined]] = STATUS_QUARANTINED
                 if run.enabled and quarantined.any():
                     bad = [int(i) for i in rows_idx[quarantined]]
@@ -1497,7 +1648,8 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         if batched is None:
             solve_p = make_parametric_solver(
                 static, n_iter=n_iter, with_health=run_health,
-                tik_eps=hcfg["tik_eps"], tik_cond_tol=hcfg["tik_cond_tol"])
+                tik_eps=hcfg["tik_eps"], tik_cond_tol=hcfg["tik_cond_tol"],
+                resid_trace=run_trace)
             if aero is None:
                 batched = jax.jit(jax.vmap(jax.vmap(solve_p, in_axes=(None, 0, 0)),
                                            in_axes=(0, None, None)))
@@ -1510,7 +1662,14 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             out = batched(params_stacked, zetas, betas)  # Xi [chunk, ncase, 1, 6, nw]
         else:
             out = batched(params_stacked, zetas, betas, aero)
-        Xi, hb = out if run_health else (out, None)
+        tr = None
+        if run_trace:
+            Xi, hb, tr = out
+        elif run_health:
+            Xi, hb = out
+        else:
+            hb = None
+            Xi = out
         ridx = np.asarray(row_idx)
         rows = np.asarray(
             jnp.sqrt(0.5 * jnp.sum(jnp.abs(Xi[:, :, 0]) ** 2, axis=-1)))[:n_real]
@@ -1525,6 +1684,18 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 SolveHealth(**hb_rows), hcfg["resid_tol"], hcfg["cond_tol"]))
             health_resid[ridx] = np.max(hb_rows["resid"], axis=-1)
             health_cond[ridx] = np.min(hb_rows["cond"], axis=-1)
+        if tr is not None:
+            tr_rows = np.asarray(tr)[:n_real]
+            conv_trace[ridx] = tr_rows
+            if run.enabled:
+                run.emit(
+                    "convergence_summary",
+                    chunk=start // chunk_size, n_iter=int(n_iter),
+                    designs=[int(i) for i in ridx],
+                    iters=[int(i) for i in np.max(iterations_to_tolerance(
+                        tr_rows, hcfg["resid_tol"]), axis=-1)],
+                    final_resid=[float(r) if np.isfinite(r) else None
+                                 for r in np.max(tr_rows[..., -1], axis=-1)])
         status[ridx] = reduce_design_status(st)
 
         if checkpoint:

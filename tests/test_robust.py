@@ -263,3 +263,101 @@ def test_health_sweep_warm_run_no_recompile():
     finally:
         sweep_mod._CHUNK_EXEC_HOOK = None
     assert out["status"][poison] == STATUS_QUARANTINED
+
+# ---------------------------------------------------------------------------
+# classification edge cases + residual-trace units (flight recorder)
+# ---------------------------------------------------------------------------
+
+
+def test_classify_health_all_nan_residuals():
+    """A solve whose residual channel is entirely NaN (e.g. the carry
+    went non-finite on iteration 1) must classify NONCONV, not OK —
+    ``resid > tol`` is False for NaN, so the non-finite check has to
+    catch it explicitly."""
+    h = SolveHealth(
+        resid=np.full(3, np.nan),
+        cond=np.full(3, 1e-2),
+        nonfinite=np.zeros(3, bool),
+        n_fallback=np.zeros(3, np.int32))
+    st = classify_health(h, resid_tol=1e-3, cond_tol=1e-10)
+    assert (st == STATUS_NONCONV).all()
+    # NaN conditioning is likewise never trusted as well-conditioned
+    h = SolveHealth(resid=np.array([1e-6]), cond=np.array([np.nan]),
+                    nonfinite=np.array([False]),
+                    n_fallback=np.zeros(1, np.int32))
+    assert classify_health(h, 1e-3, 1e-10).tolist() == [STATUS_ILLCOND]
+
+
+def test_classify_health_inf_first_iteration_carry():
+    """The scan seeds its residual carry with +inf; a 0-progress solve
+    reports that inf and must land NONCONV (inf > tol is True, but the
+    finiteness guard must also hold on its own)."""
+    h = SolveHealth(
+        resid=np.array([np.inf]),
+        cond=np.array([1e-2]),
+        nonfinite=np.array([False]),
+        n_fallback=np.zeros(1, np.int32))
+    assert classify_health(h, 1e-3, 1e-10).tolist() == [STATUS_NONCONV]
+
+
+def test_iterations_to_tolerance_units():
+    from raft_tpu.robust import iterations_to_tolerance
+
+    trace = np.array([
+        [1.0, 1e-2, 1e-5, 1e-7],    # first hit at index 2 -> 1-based 3
+        [1e-9, 1e-9, 1e-9, 1e-9],   # immediate -> 1
+        [1.0, 0.5, 0.2, 0.1],       # never -> n_iter + 1 sentinel
+        [1.0, np.nan, np.inf, 1e-9],  # non-finite lanes skipped
+        [np.nan, np.nan, np.nan, np.nan],  # all non-finite -> sentinel
+    ])
+    out = iterations_to_tolerance(trace, 1e-4)
+    assert out.dtype == np.int32
+    assert out.tolist() == [3, 1, 5, 4, 5]
+    # leading batch dims pass through
+    assert iterations_to_tolerance(trace.reshape(5, 1, 4), 1e-4).shape \
+        == (5, 1)
+
+
+@pytest.mark.slow
+def test_solver_resid_trace_contract():
+    """Direct solver-level trace contract: ``resid_trace=True`` returns
+    ``(Xi, health, trace[n_iter])`` with the trace in the solve's real
+    dtype, the health residual equal to the trace's last entry, and the
+    Xi/health outputs unchanged from the ``with_health`` solver."""
+    import copy
+
+    import jax.numpy as jnp
+
+    from raft_tpu.core.model import Model
+    from raft_tpu.parallel.case_solve import (design_params,
+                                              make_parametric_solver)
+
+    design = demo_spar(nw_freqs=(0.05, 0.4))
+    model = Model(copy.deepcopy(design))
+    fowt = model.fowtList[0]
+    fowt.setPosition(np.zeros(6))
+    fowt.calcStatics()
+    fowt.calcHydroConstants()
+    params, static = design_params(fowt, include_aero=False)
+
+    n_iter = 5
+    nw = static["nw"]
+    zeta = jnp.ones((1, nw), dtype=jnp.complex128)
+    beta = jnp.zeros(1)
+
+    solve_t = make_parametric_solver(static, n_iter=n_iter,
+                                     with_health=True, resid_trace=True)
+    Xi_t, health_t, trace = solve_t(params, zeta, beta)
+    solve_h = make_parametric_solver(static, n_iter=n_iter,
+                                     with_health=True)
+    Xi_h, health_h = solve_h(params, zeta, beta)
+
+    assert trace.shape == (n_iter,)
+    assert trace.dtype == np.asarray(params["w"]).dtype
+    assert np.isfinite(np.asarray(trace)).all()
+    np.testing.assert_array_equal(np.asarray(trace)[-1],
+                                  np.asarray(health_t.resid))
+    # the ys channel observes the scan; it never changes the solve
+    np.testing.assert_array_equal(np.asarray(Xi_t), np.asarray(Xi_h))
+    np.testing.assert_array_equal(np.asarray(health_t.resid),
+                                  np.asarray(health_h.resid))
