@@ -49,7 +49,8 @@ def main():
             jax.config.update("jax_platforms", platforms + ",cpu")
     except Exception:
         pass
-    from raft_tpu.config import enable_compilation_cache, smallsolve_mode
+    from raft_tpu.config import (compile_config, enable_compilation_cache,
+                                 smallsolve_mode)
     from raft_tpu.sweep import sweep
 
     # persistent compile cache: a cold process deserializes the sweep
@@ -61,6 +62,26 @@ def main():
         cpu = jax.devices("cpu")[0]
     except RuntimeError:
         cpu = accel
+
+    # self-describing run: a "cold" wall-clock against a warm
+    # serialized-executable cache is a different experiment than a truly
+    # cold one, and the backend decides which kernels actually ran —
+    # stamp both so BENCH_r* lines are comparable without reading the
+    # environment they came from
+    exec_dir = compile_config()["exec_cache"]
+    exec_entries = (len([n for n in os.listdir(exec_dir)
+                         if n.endswith(".jexec")])
+                    if exec_dir and os.path.isdir(exec_dir) else 0)
+    cache_state = {
+        "exec_cache": exec_dir or None,
+        "entries": exec_entries,
+        "state": "warm" if exec_entries else "empty",
+    }
+    backend_detail = {
+        "platform": jax.default_backend(),
+        "device_kind": str(getattr(accel, "device_kind", "?")),
+        "n_devices": len(jax.devices()),
+    }
 
     from raft_tpu.designs import production_design
 
@@ -228,6 +249,10 @@ def main():
         "unit": "s",
         "vs_baseline": round(60.0 / (dt * 1000.0 / n_designs), 3),
         "detail": {
+            # what the cold number measured: empty vs warm exec-cache at
+            # process start (entry count), and which backend ran it
+            "cache_state": cache_state,
+            "backend": backend_detail,
             "cold_s": round(dt, 2),
             # compile-vs-host overlap anatomy of the cold sweep (ledger
             # `compile_overlap` + compile_end/exec_cache events)

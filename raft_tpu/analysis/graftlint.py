@@ -942,11 +942,20 @@ def main(argv=None):
     violations = lint_paths(paths, cfg=cfg, root=root)
     counts = _baseline_counts(violations)
 
+    # [baseline] entries whose file is gone (renamed/deleted) suppress
+    # nothing and mask a future regression under the same key — flag
+    # them; --update-baseline drops them (it rewrites from the files
+    # that exist now)
+    stale = sorted(k for k in cfg.baseline
+                   if not os.path.exists(
+                       os.path.join(root, k.rsplit(":", 1)[0])))
+
     if args.update_baseline:
         target = cfg_path or os.path.join(root, "graftlint.toml")
         write_config(target, cfg, counts)
+        dropped = f", {len(stale)} stale entr(y/ies) dropped" if stale else ""
         print(f"graftlint: baseline updated ({sum(counts.values())} "
-              f"suppressed violation(s)) -> {target}")
+              f"suppressed violation(s){dropped}) -> {target}")
         return 0
 
     baseline = {} if args.no_baseline else cfg.baseline
@@ -970,8 +979,15 @@ def main(argv=None):
                 shown += 1
         for key, cur, base in over:
             print(f"graftlint: {key}: {cur} violation(s) > baseline {base}")
+    if stale and not args.quiet:
+        for key in stale:
+            print(f"graftlint: {key}: baselined file no longer exists — "
+                  "run --update-baseline to drop the stale entry")
     if loosened and not args.quiet:
+        stale_keys = set(stale)
         for key, cur, base in loosened:
+            if key in stale_keys:
+                continue  # already reported as stale above
             print(f"graftlint: {key}: {cur} < baseline {base} — run "
                   "--update-baseline to ratchet down")
     if not args.quiet:

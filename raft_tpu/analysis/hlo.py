@@ -1,0 +1,243 @@
+"""Textual IR parsers for the static program auditor (graftaudit).
+
+JAX's AOT pipeline exposes two program texts for free — no execution,
+no extra XLA work:
+
+* ``lowered.as_text()``  — StableHLO MLIR, available at the
+  compile-service submit point (it is already serialized there for the
+  exec-cache ``program_hash``), and
+* ``compiled.as_text()`` — the optimized HLO module, available once the
+  build finishes (either path: fresh compile or exec-cache load).
+
+This module parses both dialects with regexes over the text rather
+than walking jaxlib internals: the spellings below are the stable,
+documented surface (StableHLO op names; the HLO ``input_output_alias``
+/ ``num_partitions`` module attributes), while the in-memory IR objects
+are private and churn across jax releases.  Every parser degrades to
+"nothing found" on unrecognized text — the auditor's rules treat that
+as a skipped check, never a crash.
+
+Verified spellings (CPU backend, jax 0.4.x):
+
+* collectives lower as ``stablehlo.all_reduce`` etc. and compile to
+  ``all-reduce(...)`` (optionally ``-start``/``-done`` split),
+* donated parameters carry ``{jax.buffer_donor = true}`` or
+  ``{tf.aliasing_output = N}`` on the ``func.func public @main``
+  signature,
+* realized aliases appear in the HLO module header as
+  ``input_output_alias={ {0}: (0, {}, may-alias), ... }``,
+* baked-in constants are ``stablehlo.constant dense<...> : tensor<T>``.
+"""
+
+from __future__ import annotations
+
+import re
+
+__all__ = [
+    "COLLECTIVES",
+    "collective_counts",
+    "donated_params",
+    "input_output_aliases",
+    "wide_dtype_counts",
+    "large_constants",
+    "num_partitions",
+    "memory_stats",
+]
+
+# canonical (HLO-spelled) collective names the audit recognizes
+COLLECTIVES = ("all-gather", "all-reduce", "all-to-all",
+               "collective-permute", "reduce-scatter")
+
+# StableHLO spells collectives with underscores; optimized HLO with
+# dashes (and may split them into -start/-done async pairs — counted
+# once via the -start form, the -done is the same op completing)
+_STABLEHLO_COLLECTIVE = re.compile(
+    r"\bstablehlo\.(all_gather|all_reduce|all_to_all|collective_permute"
+    r"|reduce_scatter)\b")
+_HLO_COLLECTIVE = re.compile(
+    r"\b(all-gather|all-reduce|all-to-all|collective-permute"
+    r"|reduce-scatter)(-start)?\(")
+_HLO_DONE = re.compile(
+    r"\b(all-gather|all-reduce|all-to-all|collective-permute"
+    r"|reduce-scatter)-done\(")
+
+
+def collective_counts(text) -> dict:
+    """``{canonical-name: count}`` of collective ops in one program text.
+
+    Accepts either dialect (each regex simply finds nothing in the
+    other's spelling).  ``-done`` halves of async HLO pairs are not
+    counted — the ``-start`` (or the fused form) already did.
+    """
+    counts: dict = {}
+    for m in _STABLEHLO_COLLECTIVE.finditer(text):
+        name = m.group(1).replace("_", "-")
+        counts[name] = counts.get(name, 0) + 1
+    for m in _HLO_COLLECTIVE.finditer(text):
+        name = m.group(1)
+        counts[name] = counts.get(name, 0) + 1
+    return counts
+
+
+def donated_params(stablehlo_text) -> int:
+    """Number of entry parameters marked as buffer donors.
+
+    ``jit(..., donate_argnums=...)`` annotates each donated argument in
+    the lowered module — as ``{tf.aliasing_output = N}`` when the
+    lowering already paired it with an output, or ``{jax.buffer_donor =
+    true}`` when the pairing is left to XLA.  Both are the *intent* side
+    of the donation contract; the *realized* side is
+    :func:`input_output_aliases` on the compiled text.
+    """
+    return (stablehlo_text.count("jax.buffer_donor")
+            + stablehlo_text.count("tf.aliasing_output"))
+
+
+_ALIAS_ENTRY = re.compile(
+    r"\{([\d,\s]*)\}:\s*\(\s*(\d+)\s*,\s*\{[\d,\s]*\}\s*,\s*"
+    r"(may-alias|must-alias)\s*\)")
+
+
+def input_output_aliases(compiled_text):
+    """Realized input->output aliases of a compiled HLO module.
+
+    Returns a list of ``(output_index, parameter_number, kind)`` tuples
+    parsed from the module header's ``input_output_alias={...}``
+    attribute; empty when the attribute is absent (nothing aliased —
+    every "donated" buffer was actually copied).
+    """
+    start = compiled_text.find("input_output_alias={")
+    if start < 0:
+        return []
+    # brace-scan to the matching close: entries contain nested {...}
+    i = start + len("input_output_alias=")
+    depth = 0
+    for j in range(i, min(len(compiled_text), i + 100_000)):
+        ch = compiled_text[j]
+        if ch == "{":
+            depth += 1
+        elif ch == "}":
+            depth -= 1
+            if depth == 0:
+                break
+    else:
+        return []
+    body = compiled_text[i:j + 1]
+    return [(m.group(1).strip(), int(m.group(2)), m.group(3))
+            for m in _ALIAS_ENTRY.finditer(body)]
+
+
+_C128 = re.compile(r"complex<f64>|\bc128\b")
+# no \b on the left: shaped tensors spell the dtype as e.g.
+# ``tensor<4xf64>`` and ``x`` is a word character
+_F64 = re.compile(r"f64\b")
+
+
+def wide_dtype_counts(text) -> dict:
+    """``{"f64": n, "c128": n}`` token counts in either dialect.
+
+    A StableHLO complex128 is spelled ``complex<f64>`` — its inner
+    ``f64`` token is subtracted from the f64 tally so the two counts
+    partition the wide-type occurrences.
+    """
+    c128 = len(_C128.findall(text))
+    f64 = len(_F64.findall(text)) - text.count("complex<f64>")
+    return {"f64": max(0, f64), "c128": c128}
+
+
+_DTYPE_BYTES = {
+    "f64": 8, "f32": 4, "f16": 2, "bf16": 2, "f8E4M3FN": 1, "f8E5M2": 1,
+    "i64": 8, "i32": 4, "i16": 2, "i8": 1, "i1": 1,
+    "ui64": 8, "ui32": 4, "ui16": 2, "ui8": 1,
+    "complex<f32>": 8, "complex<f64>": 16,
+}
+
+_CONST_LINE = re.compile(
+    r"stablehlo\.constant\b.*:\s*tensor<([^>]*(?:<[^>]*>)?[^>]*)>")
+
+
+def _tensor_nbytes(spec):
+    """Estimated bytes of ``tensor<SPEC>``; None when unparseable."""
+    spec = spec.strip()
+    if not spec:
+        return None
+    # dtype is the suffix after the last 'x' whose token is not a digit
+    # (handles tensor<f32>, tensor<4xf32>, tensor<2x3xcomplex<f64>>)
+    parts = spec.split("x")
+    dims, dtype = [], None
+    for k, tok in enumerate(parts):
+        tok = tok.strip()
+        if tok.isdigit():
+            dims.append(int(tok))
+        else:
+            dtype = "x".join(p.strip() for p in parts[k:])
+            break
+    if dtype is None or dtype not in _DTYPE_BYTES:
+        return None
+    n = _DTYPE_BYTES[dtype]
+    for d in dims:
+        n *= d
+    return n
+
+
+def large_constants(stablehlo_text, threshold_bytes):
+    """Baked-in constants at or above ``threshold_bytes``.
+
+    Returns ``[(nbytes, type_spec, line_no)]`` for every
+    ``stablehlo.constant`` whose tensor type estimates to at least the
+    threshold.  Scalar splats and small tables pass silently; a
+    closure-captured variant batch does not.
+    """
+    out = []
+    for ln, line in enumerate(stablehlo_text.splitlines(), start=1):
+        if "stablehlo.constant" not in line:
+            continue
+        m = _CONST_LINE.search(line)
+        if m is None:
+            continue
+        nbytes = _tensor_nbytes(m.group(1))
+        if nbytes is not None and nbytes >= threshold_bytes:
+            out.append((nbytes, f"tensor<{m.group(1).strip()}>", ln))
+    return out
+
+
+_NUM_PARTITIONS = re.compile(r"\bnum_partitions\s*=\s*(\d+)")
+
+
+def num_partitions(text) -> int:
+    """SPMD partition count of a program text (either dialect: the
+    ``mhlo.num_partitions`` module attribute or the HLO header field);
+    1 when unannotated (single-device program)."""
+    m = _NUM_PARTITIONS.search(text)
+    return int(m.group(1)) if m else 1
+
+
+def memory_stats(compiled):
+    """Byte-level memory accounting of a compiled executable, or None.
+
+    Wraps ``compiled.memory_analysis()`` (``CompiledMemoryStats``),
+    which some backends/loaded executables do not implement.  The
+    ``peak_estimate`` is the classic live-set bound — arguments +
+    outputs + temporaries, minus the aliased bytes counted twice.
+    """
+    try:
+        ma = compiled.memory_analysis()
+    except Exception:
+        return None
+    if ma is None:
+        return None
+    fields = ("argument_size_in_bytes", "output_size_in_bytes",
+              "temp_size_in_bytes", "alias_size_in_bytes",
+              "generated_code_size_in_bytes")
+    stats = {}
+    for f in fields:
+        v = getattr(ma, f, None)
+        if isinstance(v, int):
+            stats[f] = v
+    if not stats:
+        return None
+    stats["peak_estimate"] = (stats.get("argument_size_in_bytes", 0)
+                              + stats.get("output_size_in_bytes", 0)
+                              + stats.get("temp_size_in_bytes", 0)
+                              - stats.get("alias_size_in_bytes", 0))
+    return stats

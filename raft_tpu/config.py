@@ -233,6 +233,45 @@ def compile_config(overrides=None) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# static program audit (raft_tpu.analysis.graftaudit)
+# ---------------------------------------------------------------------------
+
+# `enabled` arms the IR audit at the compile-service build point: every
+# executable the sweep compiles (or deserializes) is statically checked
+# against graftaudit.toml — collectives, donation aliasing, wide dtypes,
+# captured constants, memory budgets — with findings emitted as
+# `audit_finding` ledger events.  Off (the default) adds no work beyond
+# this config read per compile; the audit only ever READS program text
+# (`as_text()` / `memory_analysis()`), so arming it can never trigger an
+# extra XLA compile or perturb results.  `config` points at the
+# graftaudit.toml to audit against ("" = auto: $PWD then the repo root).
+AUDIT_DEFAULTS = {
+    "enabled": False,
+    "config": "",
+}
+
+
+def audit_config(overrides=None) -> dict:
+    """Effective static-audit configuration: defaults, then environment
+    (RAFT_TPU_AUDIT=1, RAFT_TPU_AUDIT_CONFIG=path), then ``overrides``."""
+    import os
+
+    cfg = dict(AUDIT_DEFAULTS)
+    env = os.environ.get("RAFT_TPU_AUDIT")
+    if env is not None:
+        cfg["enabled"] = env not in ("0", "false", "")
+    env = os.environ.get("RAFT_TPU_AUDIT_CONFIG")
+    if env is not None:
+        cfg["config"] = env
+    if overrides:
+        unknown = set(overrides) - set(cfg)
+        if unknown:
+            raise ValueError(f"unknown audit config key(s): {sorted(unknown)}")
+        cfg.update(overrides)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
 # run-ledger telemetry / trace capture (raft_tpu.obs)
 # ---------------------------------------------------------------------------
 

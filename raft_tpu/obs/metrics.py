@@ -418,6 +418,9 @@ class _Std:
         self.replay_bundles = c(
             "raft_replay_bundles_total",
             "Flight-recorder replay bundles written")
+        self.audit_findings = c(
+            "raft_audit_findings_total",
+            "Static IR-audit (graftaudit) findings by rule", ("rule",))
 
 
 _STD = None
@@ -640,6 +643,8 @@ def _observe(event, rec):
         m.capability_fallbacks.inc(reason=rec.get("reason", "?"))
     elif event == "replay_bundle":
         m.replay_bundles.inc()
+    elif event == "audit_finding":
+        m.audit_findings.inc(rule=rec.get("rule", "?"))
     elif event == "warning":
         m.warnings.inc()
     elif event == "run_end":

@@ -295,6 +295,23 @@ def render(events):
             lines.append("final health: " + ", ".join(
                 f"{v} {k}" for k, v in health["counts"].items() if v))
 
+    # ---- static program audit (graftaudit) ------------------------------
+    audit = by.get("audit_finding", [])
+    if audit:
+        lines += _section("static program audit")
+        tallies: dict = {}
+        for ev in audit:
+            k = f"{ev.get('program')}:{ev.get('rule')}"
+            tallies[k] = tallies.get(k, 0) + 1
+        lines.append(f"{len(audit)} IR-audit finding(s) across "
+                     f"{len(tallies)} program/rule pair(s)")
+        for ev in audit:
+            extra = ""
+            if ev.get("value") is not None and ev.get("limit") is not None:
+                extra = f" ({ev['value']} vs limit {ev['limit']})"
+            lines.append(f"  {ev.get('program')}: {ev.get('rule')}: "
+                         f"{ev.get('detail')}{extra}")
+
     # ---- checkpoint writer ----------------------------------------------
     flushes = by.get("checkpoint_flush", [])
     if flushes:

@@ -89,6 +89,12 @@ EVENTS: dict[str, tuple] = {
                                                 #   + detail, dropped
     "replay_bundle": ("design", "path"),        # capture written; + trigger,
                                                 #   status
+    # -- static program audit (raft_tpu.analysis.graftaudit) --------------
+    "audit_finding": ("program", "rule", "detail"),
+                                                # one IR-audit rule
+                                                #   violation in one built
+                                                #   executable; + value,
+                                                #   limit
     # -- persistence / phases / traces ------------------------------------
     "checkpoint_flush": ("seconds", "ok"),
     "phase": ("name", "seconds"),               # streamed per phase exit

@@ -33,13 +33,14 @@ from __future__ import annotations
 import hashlib
 import os
 import pickle
+import sys
 import threading
 import time
 
 import jax
 
 from .. import profiling
-from ..config import compile_config
+from ..config import audit_config, compile_config
 from ..obs import ledger as obs_ledger
 from ..obs import log as obs_log
 from ..obs import metrics as obs_metrics
@@ -69,6 +70,21 @@ _ENTRY_VERSION = 2
 # lets a process on a DIFFERENT backend warn instead of silently missing
 # every (backend-fingerprinted) lookup.
 _PIN_FILE = "BACKEND"
+
+
+def _audit_armed() -> bool:
+    """Should built executables be statically audited (graftaudit)?
+
+    Checked per build, not per chunk — the cost when off is one config
+    read.  The module lookup (instead of an import) keeps the off path
+    from ever paying the graftaudit import: when the module is already
+    loaded its :func:`~raft_tpu.analysis.graftaudit.armed` also honors
+    an active CLI ``collecting()`` context on top of RAFT_TPU_AUDIT.
+    """
+    ga = sys.modules.get("raft_tpu.analysis.graftaudit")
+    if ga is not None:
+        return bool(ga.armed())
+    return bool(audit_config()["enabled"])
 
 
 def program_hash(lowered) -> str:
@@ -352,6 +368,19 @@ class CompileService:
                         _store_entry(entry_path, task.key, cache_tag, phash,
                                      compiled, run)
                 task.result = compiled
+                # static IR audit (graftaudit): read-only over the
+                # program text/stats already in hand — no tracing, no
+                # extra XLA compile — and never fatal to the build
+                if _audit_armed():
+                    try:
+                        from ..analysis import graftaudit
+
+                        graftaudit.observe_program(
+                            task.key, cache_tag, lowered, compiled,
+                            run=run)
+                    except Exception:
+                        _LOG.warning("graftaudit hook failed for %s",
+                                     task.key, exc_info=True)
                 if warm_args_fn is not None:
                     try:
                         jax.block_until_ready(compiled(*warm_args_fn()))

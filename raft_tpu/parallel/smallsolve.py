@@ -11,9 +11,17 @@ tile: a ~28x memory blowup and no lane parallelism (measured 462 ms for
 dimension instead — arrays are [6, 6, B] — and an unrolled Gauss-Jordan
 elimination with per-element partial pivoting runs the whole batch as
 ~220 fused vector ops over [B] lanes (measured 11 ms for the same 240k:
-~40x).  A Pallas kernel tiles B through VMEM so every elimination step
-stays on-chip; the plain-jnp path is the portable fallback (CPU tests,
-interpret mode) with identical arithmetic.
+~40x).  Two arithmetically identical implementations compete for each
+problem size: the plain-jnp path (XLA fuses the unrolled steps itself)
+and a Pallas kernel that tiles B through VMEM with an autotuned block
+extent.  Dispatch is decided per (n, m, B) by :func:`autotune` — a
+one-shot micro-benchmark memoized per process (RAFT_TPU_SMALLSOLVE
+forces ``jnp``/``pallas``/``auto``; bench.py stamps the decisions as
+``smallsolve_tuning``).  Neither path dominates: at the BENCH per-chunk
+volume (3000x6x6x200) the r05 run measured jnp 121.6 ms vs pallas
+126.3 ms — jnp won on that chip, while larger lane counts have gone the
+other way.  The jnp path also serves as the portable fallback (CPU
+tests, interpret mode).
 
 Stability: partial pivoting over the remaining rows (same algorithm
 family as the LAPACK getrf the reference relies on).  Frequency-domain

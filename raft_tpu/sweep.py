@@ -1232,6 +1232,18 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                         rcache.pop(next(iter(rcache)))
                     rcache[rkey] = resident
 
+        # static IR audit of the chunk-gather selector (graftaudit):
+        # lowers the selector over the real resident batch — tracing
+        # only, no XLA compile; the implicit jit compile at first
+        # dispatch below is unchanged — and checks the executor's
+        # shard-local contract (NO collectives in chunk selection)
+        if resident is not None:
+            from .parallel.compile_service import _audit_armed
+            if _audit_armed():
+                from .analysis import graftaudit
+                graftaudit.observe_gather(
+                    chunk_selector(d_sh), (resident, np.int32(0)), run=run)
+
         # flight-recorder anomaly capture: armed only with a bundle
         # directory, and only on this batched path — a replay bundle
         # re-runs its single design through sweep(design, axes=[], ...),

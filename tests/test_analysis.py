@@ -418,6 +418,35 @@ def test_repo_is_clean_against_baseline():
     assert not over, f"lint regressions vs graftlint.toml baseline: {over}"
 
 
+def test_stale_baseline_entries_flagged_and_dropped(tmp_path, monkeypatch,
+                                                   capsys):
+    """A [baseline] entry whose file was renamed/deleted suppresses
+    nothing and masks a future regression under the same key: plain runs
+    must name it (without failing — the tree is still clean), and
+    --update-baseline must drop it."""
+    from raft_tpu.analysis.graftlint import load_config, main
+
+    (tmp_path / "real.py").write_text(
+        "def f(x):\n    print(x)\n    return x\n")
+    cfg = tmp_path / "graftlint.toml"
+    cfg.write_text('[baseline]\n"real.py:GL-PRINT" = 1\n'
+                   '"gone.py:GL-PRINT" = 2\n')
+    monkeypatch.chdir(tmp_path)
+
+    rc = main(["real.py", "--config", str(cfg)])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert ("gone.py:GL-PRINT: baselined file no longer exists" in out)
+    # reported as stale, not double-reported as a loosened ratchet
+    assert out.count("gone.py:GL-PRINT") == 1
+
+    rc = main(["real.py", "--config", str(cfg), "--update-baseline"])
+    out = capsys.readouterr().out
+    assert rc == 0
+    assert "1 stale entr(y/ies) dropped" in out
+    assert load_config(str(cfg)).baseline == {"real.py:GL-PRINT": 1}
+
+
 # ---------------------------------------------------------------------------
 # shape contracts
 # ---------------------------------------------------------------------------

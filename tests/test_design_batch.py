@@ -341,3 +341,47 @@ def test_turbine_variant_fowt_matches_full_model_build():
     # the template FOWT must be untouched by the variant build
     assert float(fowt.rotorList[0].mRNA) == pytest.approx(m0)
     assert np.asarray(rna_params_for(fowt)["mRNA"])[0] == pytest.approx(m0)
+
+
+@pytest.mark.slow
+def test_fifty_value_turbine_axis_plan_time_bounded():
+    """A control-gain-style DOE — one turbine axis, 50 values — must
+    plan in O(light-variant) host time: the sweep builds ONE full Model
+    template and then light per-combo FOWT rebuilds (shallow copy +
+    rotor rebuild, sweep._turbine_variant_fowt), never 50 Model builds.
+    Timed directly against the full build so the bound tracks the
+    machine, then exercised end-to-end through the sweep."""
+    import copy
+    import time
+
+    from raft_tpu import sweep as sweep_mod
+    from raft_tpu.core.model import Model
+    from raft_tpu.robust import STATUS_OK
+
+    base = _demo()
+    m0 = base["turbine"]["mRNA"]
+    values = [float(v) for v in np.linspace(0.7 * m0, 1.3 * m0, 50)]
+    axes = [("turbine.mRNA", values)]
+
+    t0 = time.perf_counter()
+    template = Model(copy.deepcopy(base))
+    t_full = time.perf_counter() - t0
+    fowt = template.fowtList[0]
+    fowt.setPosition(np.zeros(6))
+
+    t0 = time.perf_counter()
+    variants = [sweep_mod._turbine_variant_fowt(fowt, base, axes, [0], (v,))
+                for v in values]
+    t_light = time.perf_counter() - t0
+    assert len(variants) == 50
+    # 50 light rebuilds must cost no more than ~10 extra full builds
+    # (a regression to per-variant Model() costs 50x and trips this)
+    assert t_light < max(5.0, 10.0 * t_full), (t_light, t_full)
+    # each variant is live (the axis value landed in the rotors)
+    mrna = np.asarray([float(v.rotorList[0].mRNA) for v in variants])
+    np.testing.assert_allclose(mrna, values, rtol=1e-12)
+
+    out = sweep_mod.sweep(base, axes, STATES[:1], n_iter=8)
+    assert out["motion_std"].shape == (50, 1, 6)
+    assert np.isfinite(out["motion_std"]).all()
+    assert (out["status"] == STATUS_OK).all()

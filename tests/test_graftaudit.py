@@ -1,0 +1,546 @@
+"""graftaudit: IR-level static audit of the compiled sweep programs.
+
+Three layers under test:
+
+1. the :mod:`raft_tpu.analysis.hlo` parsers against real StableHLO /
+   optimized-HLO text from tiny jitted programs (never synthetic-only —
+   the spellings are the contract with the backend);
+2. every audit rule catches a deliberately injected violation of its
+   class — a forced reshard (shard_map psum), an un-donated buffer, an
+   f64 promotion, an oversized captured constant, a memory budget
+   breach — and stays quiet on the clean variant;
+3. the live plumbing: compile-service / gather hooks, `audit_finding`
+   ledger events + the `raft_audit_findings_total` metric, the
+   graftaudit.toml ratchet, and the zero-overhead pin — auditing a cold
+   sweep adds ZERO XLA compiles and leaves every result array
+   bit-identical.
+"""
+
+import json
+import os
+
+import numpy as np
+import pytest
+
+import jax
+import jax.numpy as jnp
+from jax.sharding import Mesh, PartitionSpec as P
+
+from raft_tpu.analysis import graftaudit, hlo
+from raft_tpu.designs import demo_spar
+from raft_tpu.obs import ledger as obs_ledger
+from raft_tpu.obs import metrics as obs_metrics
+from raft_tpu.obs import schema as obs_schema
+
+AXES = [("platform.members.0.d",
+         [[9.4, 9.4, 6.5, 6.5], [10.0, 10.0, 6.5, 6.5]])]
+STATES = [(4.0, 8.0)]
+
+
+def _shard_map():
+    try:
+        from jax.experimental.shard_map import shard_map
+    except ImportError:  # moved in newer jax
+        from jax.experimental import shard_map as _sm
+
+        return _sm.shard_map
+    return shard_map
+
+
+def _psum_program():
+    """A jitted shard_map whose body psums over the mesh axis — the
+    exact shape of an accidental reshard/replication in the sweep."""
+    mesh = Mesh(np.array(jax.devices()[:8]), ("design",))
+    f = _shard_map()(lambda x: jax.lax.psum(x, "design"), mesh=mesh,
+                     in_specs=P("design"), out_specs=P(),
+                     check_rep=False)
+    lowered = jax.jit(f).lower(jnp.arange(8.0, dtype=jnp.float32))
+    return lowered, lowered.compile()
+
+
+# ---------------------------------------------------------------------------
+# hlo parsers against real program text
+# ---------------------------------------------------------------------------
+
+
+def test_collective_counts_both_dialects_and_partitions():
+    lowered, compiled = _psum_program()
+    for text in (lowered.as_text(), compiled.as_text()):
+        counts = hlo.collective_counts(text)
+        assert counts.get("all-reduce", 0) >= 1, counts
+        assert hlo.num_partitions(text) == 8
+    # a collective-free program reports neither partitions nor ops
+    clean = jax.jit(lambda x: x + 1.0).lower(jnp.zeros(4))
+    assert hlo.collective_counts(clean.as_text()) == {}
+    assert hlo.num_partitions(clean.as_text()) == 1
+
+
+def test_hlo_done_halves_not_double_counted():
+    text = ('  %ar0 = all-reduce-start(f32[8] %p0), replica_groups={}\n'
+            '  %ar1 = all-reduce-done(f32[8] %ar0)\n')
+    assert hlo.collective_counts(text) == {"all-reduce": 1}
+
+
+def test_donation_markers_and_realized_aliases():
+    f = jax.jit(lambda x: x * 2.0 + 1.0, donate_argnums=0)
+    lowered = f.lower(jnp.zeros((256,), jnp.float32))
+    assert hlo.donated_params(lowered.as_text()) == 1
+    aliases = hlo.input_output_aliases(lowered.compile().as_text())
+    assert len(aliases) == 1 and aliases[0][1] == 0, aliases
+    # the un-donated twin carries neither marker nor alias
+    g = jax.jit(lambda x: x * 2.0 + 1.0)
+    glow = g.lower(jnp.zeros((256,), jnp.float32))
+    assert hlo.donated_params(glow.as_text()) == 0
+    assert hlo.input_output_aliases(glow.compile().as_text()) == []
+
+
+def test_alias_parser_brace_scan_multi_entry():
+    text = ("HloModule m, input_output_alias={ {0}: (0, {}, may-alias), "
+            "{1}: (2, {}, must-alias) }, entry_computation_layout=...")
+    got = hlo.input_output_aliases(text)
+    assert [(a[1], a[2]) for a in got] == [(0, "may-alias"),
+                                          (2, "must-alias")]
+
+
+def test_wide_dtype_counts_partition_f64_and_c128():
+    text = ("%0 = stablehlo.constant dense<1.0> : tensor<4xf64>\n"
+            "%1 = stablehlo.multiply %a, %b : tensor<2xcomplex<f64>>\n")
+    counts = hlo.wide_dtype_counts(text)
+    assert counts == {"f64": 1, "c128": 1}
+
+
+def test_large_constants_parse_and_threshold():
+    big = np.arange(65536, dtype=np.float32)  # 256 KiB
+    f = jax.jit(lambda x: x + jnp.asarray(big))
+    text = f.lower(jnp.zeros(65536, jnp.float32)).as_text()
+    found = hlo.large_constants(text, 1 << 10)
+    assert found and found[0][0] == 65536 * 4
+    assert "65536xf32" in found[0][1]
+    assert hlo.large_constants(text, (1 << 20)) == []  # under 1 MiB
+
+
+def test_memory_stats_fields_and_peak():
+    compiled = jax.jit(lambda x: x * 2.0).lower(
+        jnp.zeros((128,), jnp.float32)).compile()
+    stats = hlo.memory_stats(compiled)
+    assert stats is not None
+    assert stats["peak_estimate"] == (
+        stats.get("argument_size_in_bytes", 0)
+        + stats.get("output_size_in_bytes", 0)
+        + stats.get("temp_size_in_bytes", 0)
+        - stats.get("alias_size_in_bytes", 0))
+
+
+# ---------------------------------------------------------------------------
+# audit rules: one injected violation per class
+# ---------------------------------------------------------------------------
+
+
+def test_ga_collective_catches_forced_reshard():
+    lowered, compiled = _psum_program()
+    res = graftaudit.audit_program(
+        "p", stablehlo_text=lowered.as_text(), compiled=compiled,
+        allow_wide=True)
+    assert res.program == "p@8"
+    hits = [f for f in res.findings if f.rule == "GA-COLLECTIVE"]
+    assert hits and "all-reduce" in hits[0].detail
+    # the same op declared expected is no finding
+    spec = graftaudit.AuditSpec(
+        expect_collectives={"p@8": ["all-reduce"]})
+    res2 = graftaudit.audit_program(
+        "p", stablehlo_text=lowered.as_text(), compiled=compiled,
+        spec=spec, allow_wide=True)
+    assert not [f for f in res2.findings if f.rule == "GA-COLLECTIVE"]
+
+
+def test_ga_donation_catches_unrealized_and_floor():
+    donated = jax.jit(lambda x: x * 2.0 + 1.0, donate_argnums=0)
+    dlow = donated.lower(jnp.zeros((256,), jnp.float32))
+    undonated = jax.jit(lambda x: x * 2.0 + 1.0)
+    ulow = undonated.lower(jnp.zeros((256,), jnp.float32))
+    utext = ulow.compile().as_text()
+
+    # donated intent + a compiled module that aliased nothing -> finding
+    res = graftaudit.audit_program(
+        "k", stablehlo_text=dlow.as_text(), compiled_text=utext,
+        allow_wide=True)
+    assert [f.rule for f in res.findings] == ["GA-DONATION"]
+    # realized donation is clean
+    res_ok = graftaudit.audit_program(
+        "k", stablehlo_text=dlow.as_text(),
+        compiled_text=dlow.compile().as_text(), allow_wide=True)
+    assert not res_ok.findings and res_ok.aliases == 1
+    # an [expect.donation] floor catches a silently dropped donation
+    spec = graftaudit.AuditSpec(expect_donation={"k@1": 1})
+    res_floor = graftaudit.audit_program(
+        "k", stablehlo_text=ulow.as_text(), compiled_text=utext,
+        spec=spec, allow_wide=True)
+    assert [f.rule for f in res_floor.findings] == ["GA-DONATION"]
+    assert res_floor.findings[0].limit == 1
+
+
+def test_ga_f64_catches_promotion_when_x64_off_for_audit():
+    # tests run with x64 ON, so the audited program legitimately holds
+    # f64 — allow_wide=False models the production (x64-off) audit
+    f = jax.jit(lambda x: x * 2.0)
+    text = f.lower(jnp.zeros(8, jnp.float64)).as_text()
+    res = graftaudit.audit_program("k", stablehlo_text=text,
+                                   allow_wide=False)
+    assert [f_.rule for f_ in res.findings] == ["GA-F64"]
+    # the default reads jax_enable_x64 (True here) and skips the rule
+    res_default = graftaudit.audit_program("k", stablehlo_text=text)
+    assert not [f_ for f_ in res_default.findings if f_.rule == "GA-F64"]
+
+
+def test_ga_constant_catches_captured_array():
+    big = np.arange(65536, dtype=np.float32)  # 256 KiB
+    f = jax.jit(lambda x: x + jnp.asarray(big))
+    text = f.lower(jnp.zeros(65536, jnp.float32)).as_text()
+    spec = graftaudit.AuditSpec(constant_bytes=1 << 10)
+    res = graftaudit.audit_program("k", stablehlo_text=text, spec=spec,
+                                   allow_wide=True)
+    hits = [f_ for f_ in res.findings if f_.rule == "GA-CONSTANT"]
+    assert hits and hits[0].value == 65536 * 4
+    # the default 1 MiB threshold lets the same program pass
+    res_ok = graftaudit.audit_program("k", stablehlo_text=text,
+                                      allow_wide=True)
+    assert not res_ok.findings
+
+
+def test_ga_memory_catches_budget_breach():
+    compiled = jax.jit(lambda x: x * 2.0).lower(
+        jnp.zeros((1024,), jnp.float32)).compile()
+    spec = graftaudit.AuditSpec(budget={"test:k@1": 1})
+    res = graftaudit.audit_program("k", compiled=compiled, spec=spec,
+                                   budget_profile="test", allow_wide=True)
+    hits = [f for f in res.findings if f.rule == "GA-MEMORY"]
+    assert hits and hits[0].limit == 1 and hits[0].value > 1
+    # no profile selected -> budgets do not apply
+    res_off = graftaudit.audit_program("k", compiled=compiled, spec=spec,
+                                       allow_wide=True)
+    assert not res_off.findings
+
+
+# ---------------------------------------------------------------------------
+# baseline / budget ratchet (graftaudit.toml)
+# ---------------------------------------------------------------------------
+
+
+def test_diff_baseline_over_and_loosened():
+    over, loosened = graftaudit.diff_baseline(
+        {"B@8:GA-COLLECTIVE": 2, "A@1:GA-F64": 1},
+        {"B@8:GA-COLLECTIVE": 1, "gone@1:GA-CONSTANT": 3})
+    assert over == [("A@1:GA-F64", 1, 0), ("B@8:GA-COLLECTIVE", 2, 1)]
+    assert loosened == [("gone@1:GA-CONSTANT", 0, 3)]
+
+
+def test_write_spec_roundtrip_and_budget_ratchets_down_only(tmp_path):
+    path = str(tmp_path / "graftaudit.toml")
+    spec = graftaudit.AuditSpec(
+        constant_bytes=2048, memory_headroom=1.5,
+        expect_collectives={"B@8": ["all-gather"]},
+        expect_donation={"B@1": 2},
+        budget={"demo:B@1": 100})
+    graftaudit.write_spec(path, spec, {"B@8:GA-COLLECTIVE": 1})
+    spec2 = graftaudit.load_spec(path)
+    assert spec2.constant_bytes == 2048
+    assert spec2.memory_headroom == 1.5
+    assert spec2.expect_collectives == {"B@8": ["all-gather"]}
+    assert spec2.expect_donation == {"B@1": 2}
+    assert spec2.budget == {"demo:B@1": 100}
+    assert spec2.baseline == {"B@8:GA-COLLECTIVE": 1}
+
+    # budgets: existing entries only ever go DOWN; missing entries are
+    # seeded at peak * headroom
+    results = [
+        graftaudit.AuditResult(program="B@1",
+                               memory={"peak_estimate": 1000}),
+        graftaudit.AuditResult(program="A@1",
+                               memory={"peak_estimate": 10}),
+    ]
+    graftaudit.write_spec(path, spec2, {}, results=results,
+                          budget_profile="demo")
+    spec3 = graftaudit.load_spec(path)
+    assert spec3.budget["demo:B@1"] == 100     # 1500 proposed, kept low
+    assert spec3.budget["demo:A@1"] == 15      # seeded at 10 * 1.5
+    assert spec3.baseline == {}
+
+    # a smaller measured peak ratchets the existing entry down
+    graftaudit.write_spec(
+        path, spec3, {},
+        results=[graftaudit.AuditResult(program="B@1",
+                                        memory={"peak_estimate": 20})],
+        budget_profile="demo")
+    assert graftaudit.load_spec(path).budget["demo:B@1"] == 30
+
+
+def test_find_config_path_env_override(tmp_path, monkeypatch):
+    cfg = tmp_path / "custom.toml"
+    cfg.write_text("[audit]\nconstant_bytes = 7\n")
+    monkeypatch.setenv("RAFT_TPU_AUDIT_CONFIG", str(cfg))
+    assert graftaudit.find_config_path() == str(cfg)
+    assert graftaudit.load_spec(graftaudit.find_config_path()
+                                ).constant_bytes == 7
+    monkeypatch.setenv("RAFT_TPU_AUDIT_CONFIG", "")
+    # falls through to the repo-root graftaudit.toml
+    got = graftaudit.find_config_path()
+    assert got is None or os.path.basename(got) == "graftaudit.toml"
+
+
+def test_repo_config_pins_shard_local_contract():
+    """The checked-in graftaudit.toml must keep the canonical sweep
+    programs collective-free (empty expected sets) and carry demo
+    budgets for the CI-audited shapes."""
+    root = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    spec = graftaudit.load_spec(os.path.join(root, "graftaudit.toml"))
+    for prog in ("A@1", "B@1", "gather@1", "A@8", "B@8", "gather@8"):
+        assert spec.expect_collectives.get(prog) == [], prog
+    for key in ("demo:A@1", "demo:B@1", "demo:A@8", "demo:B@8",
+                "bench:A@1", "bench:B@1"):
+        assert spec.budget.get(key, 0) > 0, key
+    assert spec.baseline == {}
+
+
+# ---------------------------------------------------------------------------
+# ledger events + metric
+# ---------------------------------------------------------------------------
+
+
+def test_record_emits_schema_valid_event_and_metric(tmp_path, monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_LEDGER", str(tmp_path / "ledger"))
+    monkeypatch.setenv("RAFT_TPU_METRICS", "1")  # run latches this knob
+    run = obs_ledger.start_run("audit-unit")
+    finding = graftaudit.Finding("X@1", "GA-MEMORY", "over budget",
+                                 value=10, limit=5)
+    res = graftaudit.AuditResult(program="X@1", findings=[finding])
+    graftaudit._record(res, run=run)
+    run.finish(ok=True)
+    events = obs_ledger.read_events(run.path)
+    audit = [e for e in events if e.get("event") == "audit_finding"]
+    assert len(audit) == 1
+    ev = audit[0]
+    assert (ev["program"], ev["rule"]) == ("X@1", "GA-MEMORY")
+    assert (ev["value"], ev["limit"]) == (10, 5)
+    assert not obs_schema.validate_event(ev)
+    # the run-attached path counts through the metrics event observer
+    assert obs_metrics.std().audit_findings.value(rule="GA-MEMORY") >= 1
+    # session collector drained exactly once
+    got = graftaudit.take_results()
+    assert res in got and graftaudit.take_results() == []
+
+
+def test_record_without_run_increments_metric_directly(monkeypatch):
+    monkeypatch.setenv("RAFT_TPU_METRICS", "1")
+    before = obs_metrics.std().audit_findings.value(rule="GA-F64")
+    res = graftaudit.AuditResult(
+        program="Y@1",
+        findings=[graftaudit.Finding("Y@1", "GA-F64", "wide")])
+    graftaudit._record(res, run=None)
+    assert obs_metrics.std().audit_findings.value(rule="GA-F64") == before + 1
+    graftaudit.take_results()
+
+
+def test_observe_program_never_raises_on_garbage():
+    class Broken:
+        def as_text(self):
+            raise RuntimeError("boom")
+
+        def memory_analysis(self):
+            raise RuntimeError("boom")
+
+    assert graftaudit.observe_program("bad", None, Broken(), Broken()) == []
+    graftaudit.take_results()
+
+
+# ---------------------------------------------------------------------------
+# live integration: hooks, CLI, zero-overhead pin
+# ---------------------------------------------------------------------------
+
+
+def _bit_identical(a, b):
+    for k in ("motion_std", "AxRNA_std", "mass", "displacement", "GMT",
+              "status"):
+        x, y = np.asarray(a[k]), np.asarray(b[k])
+        assert x.dtype == y.dtype, (k, x.dtype, y.dtype)
+        np.testing.assert_array_equal(x, y, err_msg=k)
+
+
+@pytest.mark.sentinel
+def test_audit_on_zero_extra_compiles_bit_identical_and_ledger(
+        tmp_path, monkeypatch):
+    """THE acceptance pin: auditing a cold sweep adds ZERO XLA backend
+    compiles (the audit only reads text/stats already in hand; the
+    gather hook lowers without compiling), leaves every result array
+    bit-identical, and an injected [expect.donation] floor violation
+    flows through to `audit_finding` ledger events + the metric."""
+    from raft_tpu import sweep as sweep_mod
+    from raft_tpu.analysis.recompile import RecompileSentinel
+
+    design = demo_spar(nw_freqs=(0.05, 0.4))
+    dev = jax.devices()[0]
+    kw = dict(n_iter=6, chunk_size=2, device=dev)
+
+    # warm-up: eager-op and selector compiles cached for both runs
+    sweep_mod.sweep(design, AXES, STATES, **kw)
+
+    monkeypatch.setenv("RAFT_TPU_LEDGER", str(tmp_path / "ledger-off"))
+    sweep_mod._TEMPLATE_MEMO.clear()
+    with RecompileSentinel() as s_off:
+        base = sweep_mod.sweep(design, AXES, STATES, **kw)
+    off_compiles = s_off.backend_compiles
+
+    # impossible donation floor -> every audited program yields a finding
+    cfg = tmp_path / "audit.toml"
+    cfg.write_text("[expect.donation]\n"
+                   '"A@1" = 999\n"B@1" = 999\n')
+    monkeypatch.setenv("RAFT_TPU_AUDIT_CONFIG", str(cfg))
+    monkeypatch.setenv("RAFT_TPU_LEDGER", str(tmp_path / "ledger-on"))
+    monkeypatch.setenv("RAFT_TPU_METRICS", "1")
+    before = obs_metrics.std().audit_findings.value(rule="GA-DONATION")
+    sweep_mod._TEMPLATE_MEMO.clear()
+    with RecompileSentinel() as s_on:
+        with graftaudit.collecting():
+            graftaudit.take_results()
+            audited = sweep_mod.sweep(design, AXES, STATES, **kw)
+            results = graftaudit.take_results()
+
+    assert s_on.backend_compiles == off_compiles, (
+        s_on.backend_compiles, off_compiles)
+    _bit_identical(base, audited)
+
+    # both chunk executables and the gather selector were audited
+    names = {r.program for r in results}
+    assert {"A@1", "B@1", "gather@1"} <= names, names
+    for r in results:
+        if r.program in ("A@1", "B@1"):
+            assert r.findings
+            assert all(f.rule == "GA-DONATION" for f in r.findings)
+
+    # findings surfaced as ledger events + metric
+    runs = obs_ledger.list_runs(str(tmp_path / "ledger-on"))
+    events = obs_ledger.read_events(runs[-1])
+    audit_events = [e for e in events if e.get("event") == "audit_finding"]
+    assert {e["program"] for e in audit_events} == {"A@1", "B@1"}
+    assert all(e["rule"] == "GA-DONATION" for e in audit_events)
+    assert (obs_metrics.std().audit_findings.value(rule="GA-DONATION")
+            >= before + 2)
+
+
+def test_env_armed_audit_and_off_path_untouched(monkeypatch):
+    """RAFT_TPU_AUDIT=1 arms the hooks without a collecting() context;
+    unset, a warm sweep records nothing (the off path never imports or
+    runs the auditor)."""
+    from raft_tpu import sweep as sweep_mod
+    from raft_tpu.parallel.compile_service import _audit_armed
+
+    monkeypatch.delenv("RAFT_TPU_AUDIT", raising=False)
+    assert not _audit_armed()
+    design = demo_spar(nw_freqs=(0.05, 0.4))
+    dev = jax.devices()[0]
+    graftaudit.take_results()
+    sweep_mod.sweep(design, AXES, STATES, n_iter=6, chunk_size=2,
+                    device=dev)
+    assert graftaudit.take_results() == []
+
+    monkeypatch.setenv("RAFT_TPU_AUDIT", "1")
+    assert _audit_armed()
+    # warm repeat: the memoized executables skip the compile service,
+    # but the gather selector is still audited every sweep
+    sweep_mod.sweep(design, AXES, STATES, n_iter=6, chunk_size=2,
+                    device=dev)
+    results = graftaudit.take_results()
+    assert {r.program for r in results} == {"gather@1"}
+    assert not results[0].findings
+    assert results[0].collectives == {}
+
+
+def test_cli_reports_injected_finding_and_baseline_gate(
+        tmp_path, monkeypatch, capsys):
+    """CLI end-to-end on a pre-seeded exec-cache-free path: a config
+    whose [baseline] absorbs an injected finding exits 0; without the
+    baseline the same finding fails the run and lands in the JSON
+    report."""
+    lowered, compiled = _psum_program()
+    monkeypatch.setattr(
+        graftaudit, "audit_live_plan",
+        lambda *a, **k: [graftaudit.audit_program(
+            "p", stablehlo_text=lowered.as_text(), compiled=compiled,
+            spec=k.get("spec"), allow_wide=True)])
+
+    report = str(tmp_path / "report.json")
+    cfg = tmp_path / "audit.toml"
+    cfg.write_text("")
+    rc = graftaudit.main(["--demo", "--config", str(cfg),
+                          "--report", report])
+    out = capsys.readouterr().out
+    assert rc == 1
+    assert "GA-COLLECTIVE" in out and "p@8" in out
+    payload = json.load(open(report))
+    assert payload["over_baseline"]
+    assert payload["programs"][0]["collectives"] == {"all-reduce": 1}
+
+    # baselining the finding makes the same audit pass...
+    cfg.write_text('[baseline]\n"p@8:GA-COLLECTIVE" = 1\n')
+    assert graftaudit.main(["--demo", "--config", str(cfg)]) == 0
+    capsys.readouterr()
+    # ...and --no-baseline reports it again
+    assert graftaudit.main(["--demo", "--config", str(cfg),
+                            "--no-baseline"]) == 1
+    assert "GA-COLLECTIVE" in capsys.readouterr().out
+
+
+def test_cli_update_baseline_writes_ratchet(tmp_path, monkeypatch, capsys):
+    lowered, compiled = _psum_program()
+    monkeypatch.setattr(
+        graftaudit, "audit_live_plan",
+        lambda *a, **k: [graftaudit.audit_program(
+            "p", stablehlo_text=lowered.as_text(), compiled=compiled,
+            spec=k.get("spec"), allow_wide=True)])
+    cfg = tmp_path / "audit.toml"
+    cfg.write_text("")
+    rc = graftaudit.main(["--demo", "--config", str(cfg),
+                          "--update-baseline"])
+    capsys.readouterr()
+    assert rc == 0
+    spec = graftaudit.load_spec(str(cfg))
+    assert spec.baseline == {"p@8:GA-COLLECTIVE": 1}
+    # budgets seeded from the audited program's memory stats
+    assert spec.budget.get("demo:p@8", 0) > 0
+    # the baselined finding now passes the plain run
+    assert graftaudit.main(["--demo", "--config", str(cfg)]) == 0
+    capsys.readouterr()
+
+
+def test_exec_cache_audit(tmp_path, monkeypatch):
+    """Serialized executables audit from their compiled side: a cached
+    psum program is flagged for its collective; backend-mismatched and
+    corrupt entries are skipped with reasons, never fatal."""
+    import pickle
+
+    from raft_tpu.obs import ledger as _led
+    from raft_tpu.parallel import compile_service as cs
+
+    cache = tmp_path / "exec-cache"
+    cfg = {"service": False, "workers": 1, "exec_cache": str(cache)}
+    lowered, _ = _psum_program()
+    task = cs.CompileService(run=_led.NULL_RUN, config=cfg).submit(
+        "p", lowered, cache_tag="audit-test")
+    task.wait()
+    entries = [n for n in os.listdir(cache) if n.endswith(".jexec")]
+    assert entries
+
+    # corrupt entry + backend-mismatched entry ride along
+    (cache / "corrupt.jexec").write_bytes(b"not a pickle")
+    with open(cache / entries[0], "rb") as fh:
+        entry = pickle.load(fh)
+    entry["meta"] = dict(entry["meta"], backend="tpu-v9")
+    with open(cache / "othergen.jexec", "wb") as fh:
+        pickle.dump(entry, fh)
+
+    results, skipped = graftaudit.audit_exec_cache(str(cache))
+    assert len(results) == 1 and results[0].source == "exec_cache"
+    assert results[0].program == "p@8"
+    assert [f.rule for f in results[0].findings] == ["GA-COLLECTIVE"]
+    reasons = {n: why for n, why in skipped}
+    assert "corrupt.jexec" in reasons
+    assert "othergen.jexec" in reasons and "backend" in reasons["othergen.jexec"]

@@ -214,6 +214,38 @@ def test_mesh_explicit_case_axis_shape(monkeypatch):
                                rtol=1e-12, atol=0)
 
 
+def test_mesh_program_collective_set_is_golden():
+    """The collective-op set of the 8-shard chunk executables is a
+    CONTRACT, not an accident: the (design, case) mesh path is
+    shard-local by construction, so the partA/partB programs and the
+    chunk-gather selector compiled for the full 8-device mesh must
+    contain NO collectives — and graftaudit.toml must pin exactly that
+    (empty expected sets), so any resharding-inserted all-gather fails
+    CI the moment it appears."""
+    from raft_tpu.analysis import graftaudit
+
+    devs = jax.devices()
+    # one sea state: a jit_key no other test compiles, so the compile
+    # hook (cold-memo only) is guaranteed to fire for A and B here
+    with graftaudit.collecting():
+        graftaudit.take_results()
+        sweep(demo_spar(nw_freqs=(0.05, 0.4)), AXES, STATES[:1],
+              n_iter=8, chunk_size=1, devices=devs)
+        results = graftaudit.take_results()
+
+    by = {r.program: r for r in results}
+    assert {"A@8", "B@8", "gather@8"} <= set(by), sorted(by)
+    for prog in ("A@8", "B@8", "gather@8"):
+        assert by[prog].collectives == {}, (prog, by[prog].collectives)
+        assert not [f for f in by[prog].findings
+                    if f.rule == "GA-COLLECTIVE"], prog
+
+    # the checked-in expected set pins the same contract for CI
+    spec = graftaudit.load_spec(graftaudit.find_config_path())
+    for prog in ("A@8", "B@8", "gather@8"):
+        assert spec.expect_collectives.get(prog) == [], prog
+
+
 # ---------------------------------------------------------------------------
 # ledger: plan tiling, per-device dispatch, fault/dispatch overlap
 # ---------------------------------------------------------------------------
