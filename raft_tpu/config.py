@@ -405,6 +405,98 @@ def flightrec_config(overrides=None) -> dict:
     return cfg
 
 
+# Chaos fault injection (raft_tpu.robust.chaos): RAFT_TPU_CHAOS holds a
+# spec string of `seam[:key=val[,key=val]*][;seam...]` rules naming the
+# instrumented failure seams (hang, poison_fetch, device_lost,
+# compile_crash, ckpt_fail, oom_upload, preempt).  Every probabilistic
+# roll is keyed on (seed, run fingerprint, seam, chunk) so an observed
+# injection replays exactly under the same spec.  Empty spec = harness
+# fully disarmed (the production default: zero cost on the sweep path).
+CHAOS_DEFAULTS = {
+    "spec": "",    # rule string; empty disables the harness
+    "seed": 0,     # mixed into every deterministic roll
+}
+
+
+def chaos_config(overrides=None) -> dict:
+    """Effective chaos-injection configuration: defaults, then
+    environment (``RAFT_TPU_CHAOS`` / ``RAFT_TPU_CHAOS_SEED``), then
+    explicit ``overrides`` (e.g. ``sweep(..., chaos="hang:chunk=2")``)."""
+    import os
+
+    cfg = dict(CHAOS_DEFAULTS)
+    env = os.environ.get("RAFT_TPU_CHAOS")
+    if env is not None:
+        cfg["spec"] = env.strip()
+    env = os.environ.get("RAFT_TPU_CHAOS_SEED")
+    if env is not None:
+        cfg["seed"] = int(env)
+    if overrides:
+        unknown = set(overrides) - set(cfg)
+        if unknown:
+            raise ValueError(
+                f"unknown chaos config key(s): {sorted(unknown)}")
+        cfg.update(overrides)
+    return cfg
+
+
+# Elastic-execution / resilience knobs (raft_tpu.robust.elastic): the
+# per-chunk dispatch->fetch watchdog, quarantine retry backoff, graceful
+# SIGTERM/SIGINT shutdown, and device-loss re-meshing.  Everything here
+# is host-side scheduling only — none of these knobs feed a traced
+# program, so toggling them never changes results or compile counts.
+RESILIENCE_DEFAULTS = {
+    "watchdog": False,          # arm the per-chunk deadline watchdog
+    "watchdog_floor_s": 30.0,   # deadline never drops below this
+    "watchdog_mult": 10.0,      # deadline = mult x median observed chunk
+    "watchdog_cold_s": 600.0,   # deadline before any chunk has landed
+    "retry_backoff_s": 0.0,     # base quarantine-retry backoff (0 = off)
+    "retry_backoff_max_s": 30.0,
+    "graceful": "term",         # off | term (SIGTERM) | all (+ SIGINT)
+    "remesh": True,             # shrink the mesh on device loss
+}
+
+_GRACEFUL_MODES = ("off", "term", "all")
+
+
+def resilience_config(overrides=None) -> dict:
+    """Effective resilience configuration: defaults, then environment
+    (``RAFT_TPU_WATCHDOG[_FLOOR|_MULT|_COLD]``,
+    ``RAFT_TPU_RETRY_BACKOFF[_MAX]``, ``RAFT_TPU_GRACEFUL``,
+    ``RAFT_TPU_REMESH``), then explicit ``overrides``."""
+    import os
+
+    cfg = dict(RESILIENCE_DEFAULTS)
+    env = os.environ.get("RAFT_TPU_WATCHDOG")
+    if env is not None:
+        cfg["watchdog"] = env not in ("0", "false", "")
+    for key, var in (("watchdog_floor_s", "RAFT_TPU_WATCHDOG_FLOOR"),
+                     ("watchdog_mult", "RAFT_TPU_WATCHDOG_MULT"),
+                     ("watchdog_cold_s", "RAFT_TPU_WATCHDOG_COLD"),
+                     ("retry_backoff_s", "RAFT_TPU_RETRY_BACKOFF"),
+                     ("retry_backoff_max_s", "RAFT_TPU_RETRY_BACKOFF_MAX")):
+        env = os.environ.get(var)
+        if env is not None:
+            cfg[key] = float(env)
+    env = os.environ.get("RAFT_TPU_GRACEFUL")
+    if env is not None:
+        cfg["graceful"] = env
+    env = os.environ.get("RAFT_TPU_REMESH")
+    if env is not None:
+        cfg["remesh"] = env not in ("0", "false", "")
+    if overrides:
+        unknown = set(overrides) - set(cfg)
+        if unknown:
+            raise ValueError(
+                f"unknown resilience config key(s): {sorted(unknown)}")
+        cfg.update(overrides)
+    if cfg["graceful"] not in _GRACEFUL_MODES:
+        raise ValueError(
+            f"RAFT_TPU_GRACEFUL must be one of {_GRACEFUL_MODES}, "
+            f"got {cfg['graceful']!r}")
+    return cfg
+
+
 # Solver-path selection for the batched 6x6 impedance solves
 # (raft_tpu.parallel.smallsolve): 'auto' benchmarks the Pallas kernel
 # against the plain-jnp elimination at first use per (n, m, B, backend)

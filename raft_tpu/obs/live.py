@@ -10,6 +10,10 @@ process while it runs:
   progress and live ETA (the ledger's own ``chunk_commit`` ETA
   accounting), per-design status tallies.
 * ``GET /runs``    — JSON list of recent finished-run summaries.
+* ``GET /healthz`` — liveness for external supervisors: 200 normally,
+  503 while some chunk is past its watchdog deadline
+  (:func:`raft_tpu.robust.elastic.deadline_exceeded`), so an
+  orchestrator can restart a wedged sweep instead of waiting on it.
 
 This is deliberately the embryo of ``raft_tpu/serve/`` (ROADMAP item
 1): it exercises the "report on a sweep from another thread while the
@@ -25,6 +29,7 @@ JAX, so a scrape cannot perturb the sweep beyond a GIL timeslice.
 
 from __future__ import annotations
 
+import errno
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
@@ -58,9 +63,20 @@ class _Handler(BaseHTTPRequestHandler):
             elif path == "/runs":
                 self._send(200, json.dumps({"runs": metrics.recent_runs()}),
                            "application/json")
+            elif path == "/healthz":
+                # lazy import: obs must stay importable without the
+                # robust layer at module-load time (ledger -> live)
+                from ..robust import elastic
+
+                overdue = elastic.deadline_exceeded()
+                self._send(503 if overdue else 200,
+                           json.dumps({"ok": not overdue,
+                                       "watchdog_overdue": overdue}),
+                           "application/json")
             elif path == "/":
                 self._send(200, json.dumps(
-                    {"endpoints": ["/metrics", "/status", "/runs"]}),
+                    {"endpoints": ["/metrics", "/status", "/runs",
+                                   "/healthz"]}),
                     "application/json")
             else:
                 self._send(404, json.dumps({"error": "not found",
@@ -114,8 +130,10 @@ def ensure_server():
     Idempotent and cheap when unconfigured — called from every
     ``Run.__init__`` so merely starting an observed sweep brings the
     endpoint up.  Port 0 binds an ephemeral port (tests); the bound
-    address is available via :func:`server_address`.  A bind failure
-    (port in use) warns once rather than killing the sweep.
+    address is available via :func:`server_address`.  A port already in
+    use falls back to an ephemeral port (the endpoint is best-effort
+    observability; a stale sibling process must not silence it); any
+    other bind failure warns once rather than killing the sweep.
     """
     global _SERVER
     cfg = obs_config()
@@ -125,21 +143,31 @@ def ensure_server():
     with _SERVER_LOCK:
         if _SERVER is not None:
             return _SERVER
+        from . import log as obs_log
+
+        logger = obs_log.get_logger("obs.live")
         try:
             _SERVER = LiveServer(cfg["metrics_host"], int(port))
         except OSError as e:
-            from . import log as obs_log
-
-            logger = obs_log.get_logger("obs.live")
+            fallback = None
+            if int(port) != 0 and getattr(e, "errno", None) in (
+                    errno.EADDRINUSE, errno.EACCES):
+                try:
+                    fallback = LiveServer(cfg["metrics_host"], 0)
+                except OSError:
+                    fallback = None
+            if fallback is None:
+                obs_log.warn_once(
+                    logger, "live-bind-failed",
+                    f"metrics endpoint bind failed on "
+                    f"{cfg['metrics_host']}:{port}: {e}")
+                return None
+            _SERVER = fallback
             obs_log.warn_once(
-                logger, "live-bind-failed",
-                f"metrics endpoint bind failed on "
-                f"{cfg['metrics_host']}:{port}: {e}")
-            return None
-        from . import log as obs_log
-
-        obs_log.get_logger("obs.live").info(
-            "live metrics endpoint on %s", _SERVER.url)
+                logger, "live-bind-fallback",
+                f"metrics port {port} unavailable ({e}); serving on "
+                f"ephemeral port {_SERVER.port} instead")
+        logger.info("live metrics endpoint on %s", _SERVER.url)
         return _SERVER
 
 

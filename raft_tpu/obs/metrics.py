@@ -421,6 +421,24 @@ class _Std:
         self.audit_findings = c(
             "raft_audit_findings_total",
             "Static IR-audit (graftaudit) findings by rule", ("rule",))
+        self.chaos_injections = c(
+            "raft_chaos_injections_total",
+            "Chaos faults injected, by seam", ("seam",))
+        self.chunk_timeouts = c(
+            "raft_chunk_timeouts_total",
+            "Chunks past their watchdog dispatch->fetch deadline")
+        self.devices_lost = c(
+            "raft_device_lost_total",
+            "Device-loss faults detected mid-sweep")
+        self.remeshes = c(
+            "raft_remesh_total",
+            "Elastic mesh rebuilds after device loss")
+        self.preempts = c(
+            "raft_preempts_total",
+            "Sweeps drained by a stop signal", ("signal",))
+        self.watchdog_overdue = g(
+            "raft_watchdog_overdue",
+            "1 while some chunk is past its watchdog deadline")
 
 
 _STD = None
@@ -571,6 +589,7 @@ def _observe(event, rec):
         _inc_transfer(m, rec, "d2h")
     elif event == "chunk_commit":
         m.chunks_committed.inc()
+        m.watchdog_overdue.set(0)
         done = rec.get("done", 0)
         m.designs_done.set(done)
         with _STATE_LOCK:
@@ -645,6 +664,17 @@ def _observe(event, rec):
         m.replay_bundles.inc()
     elif event == "audit_finding":
         m.audit_findings.inc(rule=rec.get("rule", "?"))
+    elif event == "chaos_inject":
+        m.chaos_injections.inc(seam=rec.get("seam", "?"))
+    elif event == "chunk_timeout":
+        m.chunk_timeouts.inc()
+        m.watchdog_overdue.set(1)
+    elif event == "device_lost":
+        m.devices_lost.inc()
+    elif event == "remesh":
+        m.remeshes.inc()
+    elif event == "preempt":
+        m.preempts.inc(signal=str(rec.get("signal", "?")))
     elif event == "warning":
         m.warnings.inc()
     elif event == "run_end":

@@ -78,6 +78,16 @@ EVENTS: dict[str, tuple] = {
     "design_quarantined": ("designs",),         # + error
     "status_transition": ("designs", "to"),
     "health_report": ("counts",),               # + all_ok, quarantined
+    # -- chaos / elasticity (raft_tpu.robust.chaos / .elastic) ------------
+    "chaos_inject": ("seam",),                  # fault injected; + rule,
+                                                #   chunk
+    "chunk_timeout": ("chunk", "deadline_s"),   # watchdog deadline blown;
+                                                #   + waited_s
+    "device_lost": ("error",),                  # + devices (pre-loss ids)
+    "remesh": ("from_devices", "to_devices"),   # elastic mesh shrink
+    "preempt": ("signal",),                     # graceful-shutdown drain;
+                                                #   + done, n_designs,
+                                                #   checkpoint
     # -- flight recorder (raft_tpu.obs.flightrec) -------------------------
     "convergence_summary": ("chunk", "n_iter", "iters", "final_resid"),
                                                 # per-chunk worst-over-cases

@@ -301,12 +301,16 @@ class CompileService:
     just never stalls; kept as a bisection aid.
     """
 
-    def __init__(self, run=None, config=None):
+    def __init__(self, run=None, config=None, chaos=None):
         cfg = compile_config(config)
         self._run = run if run is not None else obs_ledger.NULL_RUN
         self._background = bool(cfg["service"])
         self._cache_dir = cfg["exec_cache"]
         self._sem = threading.BoundedSemaphore(max(1, int(cfg["workers"])))
+        # chaos: an armed robust.chaos.ChaosPlan (or None); the
+        # compile_crash seam kills a worker mid-task to exercise the
+        # sweep's inline-jit fallback
+        self._chaos = chaos
         if self._cache_dir:
             warn_if_backend_mismatch(self._cache_dir)
 
@@ -357,6 +361,11 @@ class CompileService:
                         task.source = "exec_cache"
                         task.seconds = time.perf_counter() - t0
                 if compiled is None:
+                    if self._chaos is not None:
+                        # injected worker death: lands in task.result as
+                        # the error, and the sweep's join falls back to
+                        # inline jit
+                        self._chaos.maybe_raise("compile_crash")
                     if _COMPILE_HOOK is not None:
                         _COMPILE_HOOK(task.key)
                     run.emit("compile_start", key=str(task.key), real=True)

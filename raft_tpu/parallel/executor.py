@@ -47,9 +47,88 @@ from ..obs import log as obs_log
 from ..obs import metrics as obs_metrics
 
 __all__ = ["gather_rows", "chunk_selector", "start_host_fetch",
-           "wait_for_executables", "CheckpointWriter", "FaultIsolator"]
+           "wait_for_executables", "CheckpointWriter", "FaultIsolator",
+           "ChunkTimeout", "ChunkTimer", "call_with_deadline"]
 
 _LOG = obs_log.get_logger("parallel.executor")
+
+
+class ChunkTimeout(RuntimeError):
+    """A chunk blew its dispatch->fetch watchdog deadline.
+
+    Typed so the sweep can route it into the retry-then-bisect
+    quarantine (or a remesh) instead of hanging the pipeline.
+    """
+
+    def __init__(self, seconds, what="chunk"):
+        super().__init__(
+            f"{what} exceeded its {seconds:.1f}s dispatch->fetch deadline")
+        self.seconds = float(seconds)
+        self.what = what
+
+
+def call_with_deadline(fn, seconds, what="chunk"):
+    """Run ``fn()`` on a daemon worker; raise :class:`ChunkTimeout` if
+    it has not returned within ``seconds``.
+
+    A blocked device fetch cannot be interrupted from Python, so on
+    timeout the worker is *abandoned* (daemonized, result discarded) and
+    the caller moves on — the quarantine layer re-executes the rows.
+    Any error the worker raises after abandonment is captured in its
+    result box and dropped, never re-surfaced on another thread.
+    """
+    box = {}
+    done = threading.Event()
+
+    def _runner():
+        try:
+            box["value"] = fn()
+        except BaseException as e:  # noqa: BLE001 - relayed to the caller
+            box["error"] = e
+        finally:
+            done.set()
+
+    worker = threading.Thread(target=_runner, daemon=True,
+                              name="raft-deadline-call")
+    worker.start()
+    if not done.wait(seconds):
+        raise ChunkTimeout(seconds, what=what)
+    if "error" in box:
+        raise box["error"]
+    return box.get("value")
+
+
+class ChunkTimer:
+    """Adaptive watchdog deadline from observed chunk wall times.
+
+    Deadline = ``mult`` x the median of the last observations, floored
+    at ``floor_s``; before any chunk has landed the conservative
+    ``cold_s`` applies (first dispatch includes compile/warm-up time).
+    Thread-safe: observations arrive from commit paths that may run on
+    watchdog worker threads.
+    """
+
+    WINDOW = 32
+
+    def __init__(self, floor_s, mult, cold_s):
+        self._floor = float(floor_s)
+        self._mult = float(mult)
+        self._cold = float(cold_s)
+        self._obs = []
+        self._lock = threading.Lock()
+
+    def observe(self, seconds):
+        with self._lock:
+            self._obs.append(float(seconds))
+            del self._obs[:-self.WINDOW]
+
+    def deadline(self) -> float:
+        with self._lock:
+            obs = list(self._obs)
+        if not obs:
+            return self._cold
+        median = sorted(obs)[len(obs) // 2]
+        return max(self._floor, self._mult * median)
 
 
 def wait_for_executables(tasks, run=None):

@@ -17,8 +17,27 @@ sweep's failure modes observable and survivable:
   design still computes.
 * :mod:`raft_tpu.robust.report` — the end-of-sweep structured summary
   (counts per failure class, worst residuals, quarantined combos).
+* :mod:`raft_tpu.robust.chaos` — deterministic fault injection at the
+  sweep's named failure seams (``RAFT_TPU_CHAOS``), seeded per
+  (run-fingerprint, chunk) so every injected failure replays exactly.
+* :mod:`raft_tpu.robust.elastic` — watchdog deadlines for hung chunks,
+  graceful SIGTERM/SIGINT drain to a resumable checkpoint, and
+  device-loss re-meshing (shrink the mesh, resume mid-sweep).
 """
 
+from .chaos import (  # noqa: F401
+    ChaosDeviceLost,
+    ChaosError,
+    ChaosOOM,
+    ChaosPlan,
+)
+from .elastic import (  # noqa: F401
+    ChunkTimeout,
+    RemeshRequired,
+    ShutdownGuard,
+    SweepPreempted,
+    Watchdog,
+)
 from .health import (  # noqa: F401
     STATUS_ILLCOND,
     STATUS_NAN,

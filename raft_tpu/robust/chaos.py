@@ -1,0 +1,270 @@
+"""Deterministic fault injection for the sweep stack.
+
+The production sweep is instrumented with named *seams* — fixed points
+where a long-lived service actually fails — and this module decides,
+deterministically, whether a configured fault fires at each one:
+
+==============  ============================================================
+seam            failure injected
+==============  ============================================================
+hang            the d2h fetch of a chunk blocks for ``secs`` seconds, then
+                raises :class:`ChaosError` (under an armed watchdog the
+                deadline fires first; without one the seam degrades to a
+                slow poisoned fetch and the sweep recovers via quarantine
+                retry — either way the run completes)
+poison_fetch    the d2h fetch of a chunk raises :class:`ChaosError`
+device_lost     chunk dispatch raises :class:`ChaosDeviceLost`, a stand-in
+                for the runtime's device-loss ``XlaRuntimeError``; the
+                elastic layer re-meshes around the named device
+compile_crash   the AOT compile-service worker dies mid-task (the sweep
+                falls back to inline jit)
+ckpt_fail       a background checkpoint write raises before touching disk
+oom_upload      the resident device upload raises :class:`ChaosOOM`
+                (``RESOURCE_EXHAUSTED``); the sweep falls back to per-chunk
+                host packing
+preempt         the process sends itself SIGTERM at a chunk boundary,
+                exercising the graceful-shutdown drain + resumable
+                checkpoint path
+==============  ============================================================
+
+Spec grammar (``RAFT_TPU_CHAOS`` or ``sweep(..., chaos=...)``)::
+
+    seam[:key=val[,key=val]*][;seam...]
+
+    RAFT_TPU_CHAOS="poison_fetch:chunk=1"
+    RAFT_TPU_CHAOS="hang:chunk=0,secs=60;ckpt_fail:p=0.5"
+    RAFT_TPU_CHAOS="device_lost:chunk=1,device=3"
+
+Rule keys: ``p`` (fire probability, default 1), ``chunk`` (fire only at
+this chunk index), ``n`` (max fires; default 1 for chunk-targeted rules
+so a retried chunk succeeds, unlimited otherwise), ``secs`` (hang
+duration), ``device`` (device id reported lost).
+
+Replayability: chunk-targeted rules fire at exactly the named chunk;
+probabilistic rolls hash (seed, run fingerprint, seam, chunk-or-call
+index) — same spec + seed + design ⇒ the same faults at the same seams.
+Seams without a chunk index (``ckpt_fail``, ``compile_crash``,
+``oom_upload``) roll on their per-rule occurrence counter, so they are
+deterministic given the same occurrence order.  Every injection emits a
+``chaos_inject`` ledger event before the fault is raised.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import os
+import signal
+import threading
+
+from ..config import chaos_config
+from ..obs import ledger as obs_ledger
+
+__all__ = [
+    "SEAMS",
+    "ChaosError",
+    "ChaosDeviceLost",
+    "ChaosOOM",
+    "ChaosRule",
+    "ChaosPlan",
+    "parse_spec",
+    "plan_for",
+]
+
+SEAMS = ("hang", "poison_fetch", "device_lost", "compile_crash",
+         "ckpt_fail", "oom_upload", "preempt")
+
+_RULE_KEYS = ("p", "chunk", "n", "secs", "device")
+
+
+class ChaosError(RuntimeError):
+    """An injected fault (distinguishable from organic failures)."""
+
+
+class ChaosDeviceLost(ChaosError):
+    """Stand-in for the runtime's device-loss ``XlaRuntimeError``."""
+
+    def __init__(self, device_id=None):
+        where = f" (device {device_id})" if device_id is not None else ""
+        super().__init__(
+            f"INTERNAL: chaos: device lost{where}; "
+            "injected XlaRuntimeError stand-in")
+        self.device_id = device_id
+
+
+class ChaosOOM(ChaosError):
+    """Stand-in for a device allocation failure."""
+
+    def __init__(self):
+        super().__init__("RESOURCE_EXHAUSTED: chaos: injected allocation "
+                         "failure on resident upload")
+
+
+class ChaosRule:
+    """One parsed spec rule; fire bookkeeping lives on the instance."""
+
+    def __init__(self, seam, *, p=1.0, chunk=None, n=None, secs=30.0,
+                 device=None, text=""):
+        self.seam = seam
+        self.p = float(p)
+        self.chunk = None if chunk is None else int(chunk)
+        # chunk-targeted rules default to a single fire so the
+        # quarantine retry (or the post-remesh re-dispatch) succeeds
+        self.n = (1 if chunk is not None else None) if n is None else int(n)
+        self.secs = float(secs)
+        self.device = None if device is None else int(device)
+        self.text = text or seam
+        self.fired = 0
+        self.calls = 0
+
+    def __repr__(self):
+        return f"ChaosRule({self.text!r})"
+
+
+def parse_spec(spec) -> list:
+    """Parse a chaos spec string into :class:`ChaosRule` objects."""
+    rules = []
+    for part in (spec or "").split(";"):
+        part = part.strip()
+        if not part:
+            continue
+        seam, _, argstr = part.partition(":")
+        seam = seam.strip()
+        if seam not in SEAMS:
+            raise ValueError(
+                f"unknown chaos seam {seam!r}; expected one of {SEAMS}")
+        kw = {}
+        for item in argstr.split(","):
+            item = item.strip()
+            if not item:
+                continue
+            key, eq, val = item.partition("=")
+            key = key.strip()
+            if not eq or key not in _RULE_KEYS:
+                raise ValueError(
+                    f"bad chaos rule argument {item!r} in {part!r}; "
+                    f"expected key=value with key in {_RULE_KEYS}")
+            kw[key] = float(val) if key in ("p", "secs") else int(val)
+        rules.append(ChaosRule(seam, text=part, **kw))
+    return rules
+
+
+def _roll(seed, fingerprint, seam, key) -> float:
+    """Deterministic uniform draw in [0, 1) for one (seam, key) site."""
+    payload = f"{seed}|{fingerprint}|{seam}|{key}".encode()
+    digest = hashlib.sha256(payload).digest()
+    return int.from_bytes(digest[:8], "big") / 2.0 ** 64
+
+
+class ChaosPlan:
+    """Armed fault-injection plan for one sweep (thread-safe).
+
+    The plan object is carried across an elastic re-mesh (inside
+    ``RemeshRequired.state``) so fire budgets persist: a
+    ``device_lost:chunk=1`` rule that already fired does not re-fire
+    when the shrunk mesh replays chunk 1.
+    """
+
+    def __init__(self, rules, *, seed=0, fingerprint="",
+                 run=obs_ledger.NULL_RUN):
+        if isinstance(rules, str):
+            rules = parse_spec(rules)
+        self._rules = list(rules)
+        self._seed = int(seed)
+        self._fp = str(fingerprint)
+        self._run = run
+        self._lock = threading.Lock()
+
+    @property
+    def seams(self):
+        return tuple(sorted({r.seam for r in self._rules}))
+
+    def set_run(self, run):
+        """Point injections at the current ledger run (re-mesh re-entry)."""
+        self._run = run
+
+    def fires(self, seam, key=None, device_ids=None):
+        """Return the rule that fires at this site, consuming one unit
+        of its budget, or None."""
+        for rule in self._rules:
+            if rule.seam != seam:
+                continue
+            if (rule.device is not None and device_ids is not None
+                    and rule.device not in [int(d) for d in device_ids]):
+                continue  # the named device already left the mesh
+            if rule.chunk is not None:
+                if key is None or int(key) != rule.chunk:
+                    continue
+                hit = True
+            else:
+                with self._lock:
+                    rule.calls += 1
+                    roll_key = key if key is not None else rule.calls
+                hit = (rule.p >= 1.0
+                       or _roll(self._seed, self._fp, seam, roll_key) < rule.p)
+            if not hit:
+                continue
+            with self._lock:
+                if rule.n is not None and rule.fired >= rule.n:
+                    continue
+                rule.fired += 1
+            self._run.emit("chaos_inject", seam=seam, rule=rule.text,
+                           chunk=None if key is None else int(key))
+            return rule
+        return None
+
+    def maybe_raise(self, seam, chunk=None, device_ids=None):
+        """Raise the configured fault if a rule fires at this site."""
+        rule = self.fires(seam, key=chunk, device_ids=device_ids)
+        if rule is None:
+            return
+        if seam == "device_lost":
+            dev = rule.device
+            if dev is None and device_ids:
+                dev = int(device_ids[-1])
+            raise ChaosDeviceLost(dev)
+        if seam == "oom_upload":
+            raise ChaosOOM()
+        raise ChaosError(f"chaos: injected {seam} fault ({rule.text})")
+
+    def maybe_hang(self, chunk):
+        """Block for the rule's ``secs`` at the fetch seam, then raise.
+
+        The trailing raise makes the seam safe under a watchdog: the
+        abandoned deadline worker dies with the error captured instead
+        of resuming a zombie commit behind the retried chunk.
+        """
+        rule = self.fires("hang", key=chunk)
+        if rule is None:
+            return
+        threading.Event().wait(rule.secs)
+        raise ChaosError(f"chaos: hang released after {rule.secs:.1f}s "
+                         f"({rule.text})")
+
+    def maybe_preempt(self, chunk) -> bool:
+        """Deliver SIGTERM to this process at a chunk boundary."""
+        rule = self.fires("preempt", key=chunk)
+        if rule is None:
+            return False
+        os.kill(os.getpid(), signal.SIGTERM)
+        return True
+
+
+def plan_for(fingerprint, *, run=obs_ledger.NULL_RUN, chaos=None):
+    """Build the :class:`ChaosPlan` for one sweep, or None when disarmed.
+
+    ``chaos`` mirrors the other sweep feature knobs: ``None`` reads the
+    environment, ``False`` force-disables, a string is a spec override,
+    a dict overrides :func:`raft_tpu.config.chaos_config` keys.
+    """
+    if chaos is False:
+        return None
+    if chaos is None:
+        cfg = chaos_config()
+    elif isinstance(chaos, str):
+        cfg = chaos_config({"spec": chaos})
+    else:
+        cfg = chaos_config(dict(chaos))
+    if not cfg["spec"]:
+        return None
+    return ChaosPlan(parse_spec(cfg["spec"]), seed=cfg["seed"],
+                     fingerprint=fingerprint, run=run)

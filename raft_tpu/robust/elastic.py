@@ -1,0 +1,301 @@
+"""Elastic execution: watchdog deadlines, graceful shutdown, re-meshing.
+
+Three host-side defenses for long-lived sweeps, none of which touch a
+traced program (results and compile counts are invariant under every
+knob here):
+
+* :class:`Watchdog` — per-chunk dispatch->fetch deadlines scaled from
+  observed chunk timings (:class:`~raft_tpu.parallel.executor.ChunkTimer`).
+  A blown deadline raises the typed
+  :class:`~raft_tpu.parallel.executor.ChunkTimeout`, which the sweep
+  routes into the retry-then-bisect quarantine instead of hanging the
+  pipeline.  The module-level :func:`deadline_exceeded` flag backs the
+  live server's ``/healthz`` endpoint.
+* :class:`ShutdownGuard` — SIGTERM (and optionally SIGINT) requests a
+  drain: the sweep stops dispatching, commits in-flight chunks, flushes
+  the checkpoint writer, emits ``preempt`` + ``run_end(ok=false,
+  reason=preempted)``, and raises :class:`SweepPreempted` with a
+  resumable checkpoint on disk.  A second signal restores the previous
+  handler and re-delivers (escape hatch from a wedged drain).
+* device-loss detection + :class:`RemeshRequired` — the sweep converts a
+  device-loss failure into a :class:`RemeshRequired` carrying its
+  partial in-memory state; :func:`surviving_devices` probes the old
+  device set and the sweep re-enters on the shrunk mesh, re-keying
+  executables through the exec cache's placement-aware tag.
+"""
+
+from __future__ import annotations
+
+import os
+import signal
+import threading
+import time
+
+import numpy as np
+
+from ..obs import ledger as obs_ledger
+from ..obs import log as obs_log
+from ..parallel.executor import ChunkTimeout, ChunkTimer, call_with_deadline
+from .chaos import ChaosDeviceLost, ChaosOOM
+
+__all__ = [
+    "ChunkTimeout",
+    "SweepPreempted",
+    "RemeshRequired",
+    "Watchdog",
+    "ShutdownGuard",
+    "deadline_exceeded",
+    "is_device_loss",
+    "is_oom",
+    "surviving_devices",
+]
+
+_LOG = obs_log.get_logger("robust.elastic")
+
+# -- watchdog overdue flag (read by obs.live's /healthz) --------------------
+
+_OVERDUE_LOCK = threading.Lock()
+_OVERDUE = False
+
+
+def _set_overdue(flag):
+    global _OVERDUE
+    with _OVERDUE_LOCK:
+        _OVERDUE = bool(flag)
+
+
+def deadline_exceeded() -> bool:
+    """True while some chunk is past its watchdog deadline (process-wide)."""
+    with _OVERDUE_LOCK:
+        return _OVERDUE
+
+
+# -- typed control-flow exceptions ------------------------------------------
+
+
+class SweepPreempted(RuntimeError):
+    """The sweep drained and exited on an external stop signal.
+
+    The checkpoint (when configured) holds every committed chunk, so a
+    re-run with the same arguments resumes where the signal landed.
+    """
+
+    def __init__(self, signum, checkpoint=None, done=None, total=None):
+        try:
+            name = signal.Signals(signum).name
+        except ValueError:
+            name = str(signum)
+        progress = "" if done is None else f" after {done}/{total} designs"
+        where = (f"; resumable checkpoint at {checkpoint}" if checkpoint
+                 else "; no checkpoint configured")
+        super().__init__(f"sweep preempted by {name}{progress}{where}")
+        self.signum = signum
+        self.checkpoint = checkpoint
+        self.done = done
+        self.total = total
+
+
+class RemeshRequired(RuntimeError):
+    """A device dropped out mid-sweep; re-enter on a shrunk mesh.
+
+    ``state`` carries the interrupted attempt's in-memory result arrays
+    (fresher than any checkpoint on disk) plus the live chaos plan so
+    fire budgets survive the re-entry.
+    """
+
+    def __init__(self, error, devices, state):
+        super().__init__(f"device loss mid-sweep: "
+                         f"{type(error).__name__}: {error}")
+        self.error = error
+        self.devices = list(devices)
+        self.state = state
+
+
+# -- device-loss / OOM classification ---------------------------------------
+
+_DEVICE_LOSS_MARKERS = (
+    "device lost",
+    "device_unavailable",
+    "device unavailable",
+    "device failure",
+    "device failed",
+    "deviceallocationfailure",
+    "hardware failure",
+)
+
+_OOM_MARKERS = ("resource_exhausted", "resource exhausted", "out of memory")
+
+
+def is_device_loss(err) -> bool:
+    """Does this exception mean a device left the mesh (vs a bad solve)?"""
+    if isinstance(err, ChaosDeviceLost):
+        return True
+    if not isinstance(err, Exception) or isinstance(err, RemeshRequired):
+        return False
+    msg = str(err).lower()
+    return any(marker in msg for marker in _DEVICE_LOSS_MARKERS)
+
+
+def is_oom(err) -> bool:
+    """Does this exception mean a device allocation failure?"""
+    if isinstance(err, ChaosOOM):
+        return True
+    if not isinstance(err, Exception):
+        return False
+    msg = str(err).lower()
+    return any(marker in msg for marker in _OOM_MARKERS)
+
+
+def surviving_devices(devices, err):
+    """The device subset to rebuild the mesh on after ``err``.
+
+    Attribution order: an id named by the error (chaos stand-ins carry
+    ``device_id``), else a liveness probe per device (a tiny transfer),
+    else — when everything still probes healthy — drop the tail device,
+    so the mesh always shrinks and the remesh loop always terminates.
+    Returns [] when nothing survives (the caller re-raises).
+    """
+    import jax
+
+    lost = getattr(err, "device_id", None)
+    alive = []
+    for dev in devices:
+        if lost is not None and int(dev.id) == int(lost):
+            continue
+        try:
+            jax.device_put(np.zeros(1, np.float32), dev).block_until_ready()
+        except Exception:  # noqa: BLE001 - the probe IS the liveness test
+            _LOG.warning("device %s failed the liveness probe", dev)
+            continue
+        alive.append(dev)
+    if alive and len(alive) == len(devices):
+        # no attribution and every probe passed (e.g. a transient loss):
+        # shrink by one anyway to guarantee forward progress
+        _LOG.warning("device loss reported but every device probes "
+                     "healthy; dropping %s to guarantee progress", alive[-1])
+        alive = alive[:-1]
+    return alive
+
+
+# -- watchdog ---------------------------------------------------------------
+
+
+class Watchdog:
+    """Per-chunk dispatch->fetch deadline enforcement for the sweep."""
+
+    def __init__(self, cfg, run=obs_ledger.NULL_RUN):
+        self._timer = ChunkTimer(cfg["watchdog_floor_s"],
+                                 cfg["watchdog_mult"],
+                                 cfg["watchdog_cold_s"])
+        self._run = run
+
+    def deadline(self) -> float:
+        return self._timer.deadline()
+
+    def guard(self, fn, chunk=None, since=None):
+        """Run ``fn()`` under the current deadline.
+
+        ``since`` is the chunk's dispatch timestamp
+        (``time.perf_counter()``): with a depth-N pipeline the fetch
+        happens up to N-1 chunks after dispatch, so the budget already
+        spent in flight counts against the deadline.  The remaining
+        allowance never drops below min(1s, deadline) so a deep
+        pipeline cannot starve the fetch outright.
+        """
+        deadline = self._timer.deadline()
+        remaining = deadline
+        if since is not None:
+            elapsed = time.perf_counter() - since
+            remaining = max(deadline - elapsed, min(1.0, deadline))
+        what = "chunk" if chunk is None else f"chunk {chunk}"
+        t0 = time.perf_counter()
+        try:
+            out = call_with_deadline(fn, remaining, what=what)
+        except ChunkTimeout:
+            _set_overdue(True)
+            self._run.emit("chunk_timeout", chunk=chunk,
+                           deadline_s=round(deadline, 3),
+                           waited_s=round(time.perf_counter() - t0, 3))
+            raise
+        _set_overdue(False)
+        start = since if since is not None else t0
+        self._timer.observe(time.perf_counter() - start)
+        return out
+
+
+# -- graceful shutdown ------------------------------------------------------
+
+
+class ShutdownGuard:
+    """SIGTERM/SIGINT -> cooperative drain request (main thread only).
+
+    The first signal sets :attr:`stop_requested`; the sweep's chunk loop
+    checks it at every chunk boundary, drains in-flight work, flushes
+    the checkpoint writer and raises :class:`SweepPreempted`.  A second
+    signal restores the previous handler and re-delivers itself, so a
+    wedged drain can still be killed.  Off the main thread (or with
+    mode ``off``) the guard is a no-op: Python only allows handler
+    installation on the main thread.
+    """
+
+    def __init__(self, mode="term", run=obs_ledger.NULL_RUN):
+        self._mode = mode
+        self._run = run
+        self._prev = {}
+        self.stop_requested = False
+        self.signum = None
+
+    @property
+    def installed(self) -> bool:
+        return bool(self._prev)
+
+    @property
+    def signal_name(self):
+        if self.signum is None:
+            return None
+        try:
+            return signal.Signals(self.signum).name
+        except ValueError:
+            return str(self.signum)
+
+    def __enter__(self):
+        if (self._mode == "off"
+                or threading.current_thread() is not threading.main_thread()):
+            return self
+        wanted = [signal.SIGTERM]
+        if self._mode == "all":
+            wanted.append(signal.SIGINT)
+        for sig in wanted:
+            try:
+                self._prev[sig] = signal.signal(sig, self._handle)
+            except (ValueError, OSError) as e:
+                # raced off the main thread / unsupported platform: the
+                # sweep simply runs unguarded, as before this layer
+                _LOG.debug("cannot install handler for %s: %s", sig, e)
+        return self
+
+    def _handle(self, signum, frame):
+        del frame
+        if self.stop_requested:
+            # second signal: get out of the way and re-deliver
+            prev = self._prev.get(signum, signal.SIG_DFL)
+            if not (callable(prev) or prev in (signal.SIG_IGN,
+                                               signal.SIG_DFL)):
+                prev = signal.SIG_DFL
+            signal.signal(signum, prev)
+            os.kill(os.getpid(), signum)
+            return
+        self.stop_requested = True
+        self.signum = signum
+        _LOG.warning("received %s: draining in-flight chunks and flushing "
+                     "the checkpoint (repeat the signal to force exit)",
+                     signal.Signals(signum).name)
+
+    def __exit__(self, *exc):
+        for sig, prev in self._prev.items():
+            try:
+                signal.signal(sig, prev)
+            except (ValueError, OSError) as e:
+                _LOG.debug("cannot restore handler for %s: %s", sig, e)
+        self._prev = {}
+        return False

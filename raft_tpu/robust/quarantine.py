@@ -18,6 +18,9 @@ mechanism.
 
 from __future__ import annotations
 
+import hashlib
+import time
+
 import numpy as np
 
 from ..obs import ledger as obs_ledger
@@ -26,6 +29,24 @@ from ..obs import log as obs_log
 __all__ = ["run_isolated"]
 
 _LOG = obs_log.get_logger("robust.quarantine")
+
+
+def _backoff_delay(backoff, backoff_max, idx, attempt) -> float:
+    """Deterministic exponential backoff with hash-derived jitter.
+
+    delay = backoff * 2^attempt * (1 + jitter/2), jitter drawn from
+    sha256(idx bytes, attempt) — the same failing chunk backs off by
+    the same amount on every replay (no wall-clock or RNG state), while
+    different chunks desynchronize instead of thundering back together.
+    """
+    if backoff <= 0.0:
+        return 0.0
+    payload = (np.ascontiguousarray(np.asarray(idx, dtype=np.int64)).tobytes()
+               + int(attempt).to_bytes(4, "big"))
+    jitter = int.from_bytes(hashlib.sha256(payload).digest()[:8],
+                            "big") / 2.0 ** 64
+    return min(backoff * (2.0 ** attempt) * (1.0 + 0.5 * jitter),
+               float(backoff_max))
 
 
 def _merge(parts, idx_parts, n_rows):
@@ -53,7 +74,8 @@ def _merge(parts, idx_parts, n_rows):
 
 
 def run_isolated(run, idx, retries=1, display=0, align=1,
-                 on_quarantine=None):
+                 on_quarantine=None, backoff=0.0, backoff_max=30.0,
+                 raise_on=None):
     """Execute ``run(idx)`` with fault isolation.
 
     Parameters
@@ -86,6 +108,18 @@ def run_isolated(run, idx, retries=1, display=0, align=1,
         exception — the flight recorder's capture hook.  The callback
         runs inside its own ``try``: a failing observer can never
         change what gets quarantined.
+    backoff, backoff_max : float
+        Base / cap (seconds) for the deterministic exponential backoff
+        slept between retries of the same index set (the sweep wires
+        these from ``RAFT_TPU_RETRY_BACKOFF[_MAX]``).  The delay used is
+        emitted as ``backoff_s`` on every ``quarantine_retry`` event;
+        ``backoff=0`` (the default) keeps the historical back-to-back
+        retry.
+    raise_on : callable(Exception) -> bool | None
+        Exceptions matching the predicate propagate immediately instead
+        of being retried or bisected — the sweep's escape hatch for
+        device loss, which must reach the elastic re-mesh layer rather
+        than quarantine every design on a dead device.
 
     Returns
     -------
@@ -102,11 +136,14 @@ def run_isolated(run, idx, retries=1, display=0, align=1,
 
     with profiling.phase("isolate"):
         return _run_isolated(run, idx, retries=retries, display=display,
-                             align=align, on_quarantine=on_quarantine)
+                             align=align, on_quarantine=on_quarantine,
+                             backoff=backoff, backoff_max=backoff_max,
+                             raise_on=raise_on)
 
 
 def _run_isolated(run, idx, retries=1, display=0, align=1,
-                  on_quarantine=None, _depth=0):
+                  on_quarantine=None, backoff=0.0, backoff_max=30.0,
+                  raise_on=None, _depth=0):
     idx = np.asarray(idx)
     n = len(idx)
     last_err = None
@@ -114,13 +151,19 @@ def _run_isolated(run, idx, retries=1, display=0, align=1,
         try:
             return run(idx), np.zeros(n, dtype=bool)
         except Exception as e:  # noqa: BLE001 - isolation boundary
+            if raise_on is not None and raise_on(e):
+                raise
             last_err = e
             if attempt < retries:
-                obs_ledger.emit("quarantine_retry", n=int(n))
+                delay = _backoff_delay(backoff, backoff_max, idx, attempt)
+                obs_ledger.emit("quarantine_retry", n=int(n),
+                                backoff_s=round(delay, 6))
                 if display:
                     obs_log.display(
                         _LOG, f"sweep: chunk of {n} design(s) raised "
                               f"{type(e).__name__}; retrying once")
+                if delay > 0.0:
+                    time.sleep(delay)
 
     if n == 1:
         obs_log.warn(
@@ -153,7 +196,8 @@ def _run_isolated(run, idx, retries=1, display=0, align=1,
     for half in halves:
         res, mask = _run_isolated(run, half, retries=0, display=display,
                                   align=align, on_quarantine=on_quarantine,
-                                  _depth=_depth + 1)
+                                  backoff=backoff, backoff_max=backoff_max,
+                                  raise_on=raise_on, _depth=_depth + 1)
         parts.append(res)
         masks.append(mask)
     quarantined = np.concatenate(masks)

@@ -25,6 +25,7 @@ from __future__ import annotations
 import copy
 import functools
 import itertools
+import time
 import warnings
 import zipfile
 
@@ -34,8 +35,8 @@ import jax.numpy as jnp
 
 from . import profiling
 from .analysis.contracts import shape_contract
-from .config import (executor_config, flightrec_config, health_config,
-                     resolve_mesh_devices)
+from .config import (chaos_config, executor_config, flightrec_config,
+                     health_config, resilience_config, resolve_mesh_devices)
 from .core.model import Model
 from .obs import ledger as obs_ledger
 from .obs import log as obs_log
@@ -51,6 +52,8 @@ from .parallel.executor import (CheckpointWriter, FaultIsolator,
 from .robust import (STATUS_NAN, STATUS_OK, STATUS_QUARANTINED, SolveHealth,
                      build_report, classify_health, format_report,
                      run_isolated)
+from .robust import chaos as chaos_mod
+from .robust import elastic
 from .robust.health import (STATUS_NAMES, iterations_to_tolerance,
                             reduce_design_status)
 
@@ -242,7 +245,7 @@ def _sweep_signature(base_design, axes, combos, sea_states, n_iter, wind):
 
 def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
           checkpoint=None, chunk_size=256, wind=None, devices=None,
-          health=None, flightrec=None):
+          health=None, flightrec=None, chaos=None):
     """Run a factorial design sweep.
 
     Parameters
@@ -313,6 +316,25 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         :mod:`raft_tpu.obs.flightrec` and docs/robustness.md.  Off (the
         default) is the seed trace: bit-identical results, zero
         additional XLA compiles.
+    chaos : bool or str or dict, optional
+        Deterministic fault injection
+        (:mod:`raft_tpu.robust.chaos`): ``None`` reads ``RAFT_TPU_CHAOS``
+        (disarmed when unset), a string is a spec override (e.g.
+        ``"poison_fetch:chunk=1"``), ``False`` force-disables, a dict
+        overrides :func:`raft_tpu.config.chaos_config` keys.  Disarmed
+        (the production default) the harness costs nothing: results and
+        compile counts are bit-identical to a build without it.  See
+        docs/robustness.md "Chaos testing & elasticity".
+
+    Resilience: the watchdog / graceful-shutdown / re-mesh knobs
+    (:func:`raft_tpu.config.resilience_config`) are environment-driven —
+    ``RAFT_TPU_WATCHDOG`` arms per-chunk dispatch->fetch deadlines that
+    route a hung chunk into quarantine, SIGTERM (by default) drains
+    in-flight chunks and raises
+    :class:`~raft_tpu.robust.elastic.SweepPreempted` with a resumable
+    checkpoint flushed, and a device loss mid-sweep re-meshes onto the
+    surviving devices and resumes in place.  All of it is host-side
+    scheduling: no knob changes a traced program.
 
     Returns
     -------
@@ -361,13 +383,46 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                   "wind": wind is not None,
                   "n_devices": len(devices)})
     try:
-        out = _sweep_impl(base_design, axes, sea_states, n_iter=n_iter,
-                          device=device, display=display,
-                          checkpoint=checkpoint, chunk_size=chunk_size,
-                          wind=wind, devices=devices, mesh_shape=mesh_shape,
-                          health=health, flightrec=flightrec, run=run)
+        state = None
+        while True:
+            try:
+                out = _sweep_impl(base_design, axes, sea_states,
+                                  n_iter=n_iter, device=device,
+                                  display=display, checkpoint=checkpoint,
+                                  chunk_size=chunk_size, wind=wind,
+                                  devices=devices, mesh_shape=mesh_shape,
+                                  health=health, flightrec=flightrec,
+                                  run=run, chaos=chaos,
+                                  _resume_state=state)
+                break
+            except elastic.RemeshRequired as rq:
+                survivors = elastic.surviving_devices(rq.devices, rq.error)
+                if not survivors:
+                    raise rq.error
+                run.emit("device_lost",
+                         error=f"{type(rq.error).__name__}: {rq.error}",
+                         devices=[int(d.id) for d in rq.devices])
+                run.emit("remesh",
+                         from_devices=[int(d.id) for d in rq.devices],
+                         to_devices=[int(d.id) for d in survivors])
+                obs_log.warn(
+                    _LOG,
+                    f"sweep: device loss mid-sweep "
+                    f"({type(rq.error).__name__}: {rq.error}); re-meshing "
+                    f"onto {len(survivors)} surviving device(s) and "
+                    f"resuming", RuntimeWarning)
+                # the interrupted attempt's in-memory arrays are fresher
+                # than any checkpoint; re-enter with them and a mesh
+                # rebuilt from the survivors (executables re-key through
+                # the placement-aware jit_key / exec-cache tag)
+                devices, mesh_shape = survivors, None
+                state = rq.state
         run.finish(ok=True, counts=out["report"]["counts"])
         return out
+    except elastic.SweepPreempted as e:
+        run.finish(ok=False, reason="preempted",
+                   error=f"{type(e).__name__}: {e}")
+        raise
     except BaseException as e:
         run.finish(ok=False, error=f"{type(e).__name__}: {e}")
         raise
@@ -437,7 +492,8 @@ def precompile(base_design, axes, sea_states, n_iter=15, device=None,
 
 def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 checkpoint, chunk_size, wind, devices, health, run,
-                flightrec=None, mesh_shape=None, compile_only=False):
+                flightrec=None, mesh_shape=None, compile_only=False,
+                chaos=None, _resume_state=None):
     """:func:`sweep` body; ``run`` is the active ledger run (NULL_RUN
     when telemetry is off — every ``run.emit`` is then a no-op and all
     byte/stat collection is gated behind ``run.enabled``).
@@ -479,6 +535,23 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
     # the result arrays (NaN = never computed / fallback path row)
     conv_trace = (np.full((n_designs, n_cases, int(n_iter)), np.nan)
                   if run_trace else None)
+
+    # resilience knobs + chaos plan (raft_tpu.robust.elastic / .chaos).
+    # Both disarmed (the default) costs nothing on the sweep path: no
+    # traced program sees any of this, so results and compile counts
+    # stay bit-identical.  On re-mesh re-entry the plan is carried in
+    # ``_resume_state`` so chaos fire budgets persist across attempts.
+    rcfg = resilience_config()
+    if _resume_state is not None:
+        chaos_plan = _resume_state.get("chaos_plan")
+        if chaos_plan is not None:
+            chaos_plan.set_run(run)
+    else:
+        chaos_plan = None
+        if chaos is not False and (chaos is not None
+                                   or chaos_config()["spec"]):
+            chaos_plan = chaos_mod.plan_for(
+                _design_hash(base_design)[:16], run=run, chaos=chaos)
 
     # the production path is ALWAYS the (design, case) mesh — a single
     # device is the degenerate 1x1 mesh of the same sharded code, not a
@@ -528,6 +601,7 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
     sig = None
     if checkpoint:
         sig = _sweep_signature(base_design, axes, combos, sea_states, n_iter, wind)
+        _clean_stale_tmp(checkpoint)
         if os.path.exists(checkpoint):
             # a half-written/corrupt checkpoint (killed mid-save, disk
             # full, truncated copy) must not be able to kill the sweep it
@@ -559,6 +633,24 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                     RuntimeWarning)
                 (results, nacelle_acc, props, done,
                  status, health_resid, health_cond) = _fresh_state()
+
+    if _resume_state is not None:
+        # elastic re-mesh re-entry: the interrupted attempt's in-memory
+        # arrays are at least as fresh as any checkpoint on disk (the
+        # writer coalesces), so they win over the load above
+        results = _resume_state["results"]
+        nacelle_acc = _resume_state["nacelle_acc"]
+        props = _resume_state["props"]
+        done = _resume_state["done"]
+        status = _resume_state["status"]
+        health_resid = _resume_state["health_resid"]
+        health_cond = _resume_state["health_cond"]
+        if run_trace and _resume_state.get("conv_trace") is not None:
+            conv_trace = _resume_state["conv_trace"]
+        if display:
+            obs_log.display(
+                _LOG, f"sweep re-mesh resume: {int(done.sum())}/"
+                      f"{n_designs} designs already done")
 
     def _finalize():
         out = {"grid": combos, "motion_std": results,
@@ -1003,7 +1095,7 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             # executable identity (jit_key covers mode/placement/extents/
             # health trace) on top of the per-program StableHLO hash the
             # service adds — a changed trace can never hit a stale entry
-            compile_service = CompileService(run=run)
+            compile_service = CompileService(run=run, chaos=chaos_plan)
             pending_compile = {
                 "A": compile_service.submit(
                     "A", lA, cache_tag=repr(jit_key),
@@ -1204,22 +1296,45 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 rcache = entry.setdefault("resident", {})
                 resident = rcache.get(rkey)
             if resident is None:
-                with profiling.phase("sweep/resident_upload"):
-                    n_chunks_r = -(-n_designs // chunk_size)
-                    chunk_idx = np.empty((n_chunks_r, chunk_size),
-                                         dtype=np.int64)
-                    for k in range(n_chunks_r):
-                        c_start = k * chunk_size
-                        c_stop = min(c_start + chunk_size, n_designs)
-                        # identical padding rule to the chunk loop below
-                        row = np.arange(c_start, c_start + chunk_size)
-                        row[c_stop - c_start:] = c_stop - 1
-                        chunk_idx[k] = row
-                    cm_sh = NamedSharding(mesh, P(None, "design"))
-                    resident = [jax.device_put(b[chunk_idx], cm_sh)
-                                for b in pack_rows(stacked, spec,
-                                                   np.arange(n_designs))]
-                if run.enabled:
+                upload_err = None
+                try:
+                    if chaos_plan is not None:
+                        chaos_plan.maybe_raise("oom_upload")
+                    with profiling.phase("sweep/resident_upload"):
+                        n_chunks_r = -(-n_designs // chunk_size)
+                        chunk_idx = np.empty((n_chunks_r, chunk_size),
+                                             dtype=np.int64)
+                        for k in range(n_chunks_r):
+                            c_start = k * chunk_size
+                            c_stop = min(c_start + chunk_size, n_designs)
+                            # identical padding rule to the chunk loop below
+                            row = np.arange(c_start, c_start + chunk_size)
+                            row[c_stop - c_start:] = c_stop - 1
+                            chunk_idx[k] = row
+                        cm_sh = NamedSharding(mesh, P(None, "design"))
+                        resident = [jax.device_put(b[chunk_idx], cm_sh)
+                                    for b in pack_rows(stacked, spec,
+                                                       np.arange(n_designs))]
+                except Exception as e:  # noqa: BLE001 - OOM downgrades only
+                    # an allocation failure on the resident batch is
+                    # survivable: the per-chunk host-packing path computes
+                    # the identical results with a fraction of the
+                    # footprint.  Anything that isn't an OOM re-raises.
+                    if not elastic.is_oom(e):
+                        raise
+                    upload_err = e
+                    resident = None
+                if upload_err is not None:
+                    run.emit("capability_fallback", reason="resident_oom",
+                             detail=f"{type(upload_err).__name__}: "
+                                    f"{upload_err}")
+                    obs_log.warn(
+                        _LOG,
+                        f"sweep: resident batch upload failed "
+                        f"({type(upload_err).__name__}: {upload_err}); "
+                        f"falling back to per-chunk host packing",
+                        RuntimeWarning)
+                elif run.enabled:
                     per_dev = obs_ledger.shard_bytes(resident)
                     run.emit("transfer", direction="h2d",
                              bytes=obs_ledger.tree_nbytes(resident),
@@ -1227,7 +1342,7 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                              **({"per_device": per_dev} if per_dev else {}))
                     obs_ledger.emit_device_memory(run, device=devices,
                                                   what="resident_upload")
-                if rcache is not None:
+                if resident is not None and rcache is not None:
                     while len(rcache) >= 2:
                         rcache.pop(next(iter(rcache)))
                     rcache[rkey] = resident
@@ -1268,7 +1383,8 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             ckpt_writer = CheckpointWriter(
                 lambda st: _save_checkpoint(
                     checkpoint, sig, *st,
-                    mesh_shape=tuple(mesh.devices.shape)),
+                    mesh_shape=tuple(mesh.devices.shape),
+                    chaos=chaos_plan),
                 on_write=(lambda secs, err: run.emit(
                     "checkpoint_flush", seconds=secs, ok=err is None))
                 if run.enabled else None)
@@ -1281,7 +1397,29 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                                 nacelle_acc.copy(), status.copy(),
                                 health_resid.copy(), health_cond.copy()))
 
-        with profiling.phase("sweep/chunks"), maybe_trace("chunks"):
+        # elastic-execution machinery (robust.elastic): watchdog
+        # deadlines, graceful drain, device-loss re-meshing.  All of it
+        # is host-side scheduling — disarmed (the defaults) none of it
+        # touches the chunk hot path beyond a flag check per chunk.
+        wd = elastic.Watchdog(rcfg, run=run) if rcfg["watchdog"] else None
+        remesh_armed = bool(rcfg["remesh"]) and len(devices) > 1
+        guard = elastic.ShutdownGuard(mode=rcfg["graceful"])
+        dispatched_at = {}  # chunk start -> dispatch perf_counter
+
+        def _remesh_required(err):
+            # state is captured by reference: by the time sweep()
+            # re-enters, the drain/flush in the finally below has
+            # quiesced the isolation worker and the checkpoint writer
+            return elastic.RemeshRequired(
+                error=err, devices=list(devices),
+                state={"results": results, "nacelle_acc": nacelle_acc,
+                       "props": props, "done": done, "status": status,
+                       "health_resid": health_resid,
+                       "health_cond": health_cond,
+                       "conv_trace": conv_trace,
+                       "chaos_plan": chaos_plan})
+
+        with profiling.phase("sweep/chunks"), maybe_trace("chunks"), guard:
             # wait-for-executable: the background compiles (or exec-cache
             # deserializations) submitted in the plan phase are joined
             # HERE, at first chunk dispatch — the stall (if any) is the
@@ -1307,6 +1445,13 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 ``chunk_no`` selects the pre-staged resident chunk;
                 ``None`` (quarantine re-execution, RAFT_TPU_RESIDENT=0)
                 host-packs ``idx`` instead."""
+                if chaos_plan is not None and chunk_no is not None:
+                    # device_lost fires on pipeline dispatches only:
+                    # quarantine re-executions (chunk_no None) stay
+                    # clean so a non-loss retry path cannot trip it
+                    chaos_plan.maybe_raise(
+                        "device_lost", chunk=chunk_no,
+                        device_ids=[int(d.id) for d in devices])
                 dispatch = functools.partial(_dispatch_real,
                                              chunk_no=chunk_no)
                 if _CHUNK_EXEC_HOOK is not None:
@@ -1434,6 +1579,13 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             def _commit(entry):
                 start, stop, n_real, std, a_std, pr, hb = entry[:7]
                 tr = entry[7] if len(entry) > 7 else None
+                if chaos_plan is not None:
+                    # fetch-boundary seams: a hung d2h copy and a
+                    # poisoned fetch both surface here, where the
+                    # watchdog (when armed) can cut them loose
+                    chaos_plan.maybe_hang(start // chunk_size)
+                    chaos_plan.maybe_raise("poison_fetch",
+                                           chunk=start // chunk_size)
                 with profiling.phase("fetch"):
                     hb_rows = None
                     if hb is not None:
@@ -1471,7 +1623,15 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                         f"sweep: designs {start+1}-{stop}/{n_designs} done")
 
             def _exec_rows(sub_idx):
-                """Quarantine-runner callable: arbitrary-length design
+                """Quarantine-runner callable, watchdog-guarded when the
+                watchdog is armed (a hung re-execution must not wedge
+                the isolation worker either)."""
+                if wd is None:
+                    return _exec_rows_raw(sub_idx)
+                return wd.guard(functools.partial(_exec_rows_raw, sub_idx))
+
+            def _exec_rows_raw(sub_idx):
+                """Quarantine-runner body: arbitrary-length design
                 index array -> fetched numpy row dict.  Pads with the
                 last index so the SAME compiled chunk executables serve
                 every bisection level (no new XLA programs)."""
@@ -1529,7 +1689,11 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                                          error=err)
                 merged, quarantined = run_isolated(
                     _exec_rows, rows_idx, retries=1, display=display,
-                    align=chunk_local, on_quarantine=on_q)
+                    align=chunk_local, on_quarantine=on_q,
+                    backoff=rcfg["retry_backoff_s"],
+                    backoff_max=rcfg["retry_backoff_max_s"],
+                    raise_on=(elastic.is_device_loss if remesh_armed
+                              else None))
                 ok = ~quarantined
                 if merged is not None and ok.any():
                     hb_rows = None
@@ -1560,17 +1724,36 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             def _safe_commit(entry):
                 # dispatch is async: a poison chunk often raises only at
                 # the device->host fetch, i.e. here rather than in
-                # _dispatch
+                # _dispatch.  With the watchdog armed the fetch runs
+                # under the remaining share of the chunk's deadline
+                # (dispatch->fetch, so pipeline residency counts).
                 try:
-                    _commit(entry)
+                    if wd is None:
+                        _commit(entry)
+                    else:
+                        wd.guard(functools.partial(_commit, entry),
+                                 chunk=entry[0] // chunk_size,
+                                 since=dispatched_at.pop(entry[0], None))
                 except Exception as e:  # noqa: BLE001 - isolation boundary
+                    if remesh_armed and elastic.is_device_loss(e):
+                        raise _remesh_required(e) from e
                     _isolate(entry[0], entry[1], e)
 
             try:
                 for start in range(0, n_designs, chunk_size):
+                    if guard.stop_requested:
+                        # stop dispatching; in-flight entries drain
+                        # below and the finally flushes the checkpoint,
+                        # then SweepPreempted is raised after the block
+                        break
                     stop = min(start + chunk_size, n_designs)
                     if done[start:stop].all():
                         continue
+                    if chaos_plan is not None:
+                        # self-SIGTERM at a seeded chunk boundary: the
+                        # flag lands before the next iteration's check,
+                        # so this chunk still dispatches and commits
+                        chaos_plan.maybe_preempt(start // chunk_size)
                     # pad a short final chunk by repeating the last design so
                     # every chunk shares one leading shape (a second XLA compile
                     # would cost more than the padded rows; padded results are
@@ -1582,10 +1765,14 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                              start=start, stop=stop, n_real=n_real,
                              in_flight=len(pending) + 1,
                              devices=[int(d.id) for d in devices])
+                    if wd is not None:
+                        dispatched_at[start] = time.perf_counter()
                     try:
                         entry = (start, stop, n_real) + _dispatch(
                             idx, start // chunk_size)
                     except Exception as e:  # noqa: BLE001 - isolation boundary
+                        if remesh_armed and elastic.is_device_loss(e):
+                            raise _remesh_required(e) from e
                         _isolate(start, stop, e)
                         continue
                     pending.append(entry)
@@ -1599,12 +1786,28 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 # snapshot — the on-disk file then reflects every
                 # committed AND every quarantined chunk, same as the old
                 # synchronous saves.  drain() re-raises any unexpected
-                # isolation error on this thread.
+                # isolation error on this thread; a device loss that
+                # surfaced inside the isolation worker (run_isolated's
+                # raise_on lets it through) converts to the same
+                # RemeshRequired as a loop-side loss.
                 try:
                     isolator.drain()
+                except Exception as e:  # noqa: BLE001 - remesh boundary
+                    if remesh_armed and elastic.is_device_loss(e):
+                        raise _remesh_required(e) from e
+                    raise
                 finally:
                     if ckpt_writer is not None:
                         ckpt_writer.close()
+        if guard.stop_requested:
+            # graceful shutdown: everything in flight is committed and
+            # the checkpoint writer has flushed — exit resumable
+            n_done = int(done.sum())
+            run.emit("preempt", signal=guard.signal_name, done=n_done,
+                     n_designs=n_designs, checkpoint=checkpoint or None)
+            raise elastic.SweepPreempted(guard.signum,
+                                         checkpoint=checkpoint,
+                                         done=n_done, total=n_designs)
         if run.enabled:
             obs_ledger.emit_device_memory(run, device=devices,
                                           what="post_chunks")
@@ -1719,10 +1922,30 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
     return _finalize()
 
 
-def _save_checkpoint(checkpoint, sig, results, done, props, nacelle_acc,
-                     status, health_resid, health_cond, mesh_shape=None):
+def _clean_stale_tmp(checkpoint):
+    """Remove orphaned ``{checkpoint}.<pid>.tmp.npz`` partials.
+
+    A process killed mid-``_save_checkpoint`` leaves its tmp file
+    behind; the rename protocol guarantees it is never the live
+    checkpoint, so any survivor from another pid is garbage."""
+    import glob
     import os
 
+    for tmp in glob.glob(f"{checkpoint}.*.tmp.npz"):
+        try:
+            os.remove(tmp)
+            _LOG.debug("removed stale checkpoint partial %s", tmp)
+        except OSError as e:
+            _LOG.debug("could not remove stale partial %s: %s", tmp, e)
+
+
+def _save_checkpoint(checkpoint, sig, results, done, props, nacelle_acc,
+                     status, health_resid, health_cond, mesh_shape=None,
+                     chaos=None):
+    import os
+
+    if chaos is not None:
+        chaos.maybe_raise("ckpt_fail")
     extra = {}
     if mesh_shape is not None:
         # recorded for post-mortem attribution only: resume is
@@ -1730,8 +1953,16 @@ def _save_checkpoint(checkpoint, sig, results, done, props, nacelle_acc,
         # shard identity, so a 1-device resume of an 8-device sweep — or
         # the reverse — picks up exactly where the checkpoint left off)
         extra["mesh_shape"] = np.asarray(mesh_shape, dtype=np.int64)
-    tmp = f"{checkpoint}.{os.getpid()}.tmp.npz"  # .npz: savez keeps the name
-    np.savez(tmp, sig=sig, motion_std=results, done=done, AxRNA_std=nacelle_acc,
-             status=status, health_resid=health_resid, health_cond=health_cond,
-             **extra, **props)
+    # tmp + fsync + atomic rename: a kill at ANY point leaves either the
+    # previous complete checkpoint or the new complete one — never a
+    # truncated file (the .npz suffix keeps savez from renaming; writing
+    # through the file object lets the bytes be fsynced before replace)
+    tmp = f"{checkpoint}.{os.getpid()}.tmp.npz"
+    with open(tmp, "wb") as fh:
+        np.savez(fh, sig=sig, motion_std=results, done=done,
+                 AxRNA_std=nacelle_acc, status=status,
+                 health_resid=health_resid, health_cond=health_cond,
+                 **extra, **props)
+        fh.flush()
+        os.fsync(fh.fileno())
     os.replace(tmp, checkpoint)
