@@ -42,6 +42,11 @@ def main():
         ledger_dir = tempfile.mkdtemp(prefix="raft-bench-ledger-")
         os.environ["RAFT_TPU_LEDGER"] = ledger_dir
 
+    # arm the perf observatory (static program costs -> program_cost
+    # ledger events) unless the caller pinned it; cost extraction is
+    # AOT-read-only, so the benchmarked walls are unaffected
+    os.environ.setdefault("RAFT_TPU_PERF", "1")
+
     # Make both the accelerator and the CPU backend available.
     try:
         platforms = jax.config.jax_platforms
@@ -86,15 +91,32 @@ def main():
     from raft_tpu.designs import production_design
 
     # 200 ω-bins per the BASELINE config
-    design, _, name = production_design(min_freq=0.005, max_freq=1.0)
+    design, has_reference, name = production_design(min_freq=0.005,
+                                                    max_freq=1.0)
 
     n_designs = int(os.environ.get("RAFT_BENCH_DESIGNS", "1000"))
     n_axis = max(2, round(n_designs ** (1.0 / 3.0)))
-    axes = [
-        ("platform.members.0.d", list(np.linspace(9.0, 10.7, n_axis))),
-        ("platform.members.1.d", list(np.linspace(11.5, 13.0, n_axis))),
-        ("platform.members.1.l_fill", list(np.linspace(1.0, 1.8, n_axis))),
-    ]
+    if has_reference:
+        axes = [
+            ("platform.members.0.d", list(np.linspace(9.0, 10.7, n_axis))),
+            ("platform.members.1.d", list(np.linspace(11.5, 13.0, n_axis))),
+            ("platform.members.1.l_fill", list(np.linspace(1.0, 1.8, n_axis))),
+        ]
+    else:
+        # the demo-spar fallback has ONE member (the VolturnUS axes
+        # above would index members.1 out of range): span the same
+        # n_axis^3 design count over its diameter profile, wall
+        # thickness, and ballast fill instead
+        axes = [
+            ("platform.members.0.d",
+             [[float(dv), float(dv), 6.5, 6.5]
+              for dv in np.linspace(9.0, 10.7, n_axis)]),
+            ("platform.members.0.t",
+             [[float(tv)] * 4 for tv in np.linspace(0.024, 0.030, n_axis)]),
+            ("platform.members.0.l_fill",
+             [[float(lv), 0.0, 0.0]
+              for lv in np.linspace(48.0, 56.0, n_axis)]),
+        ]
     n_designs = n_axis**3
 
     n_case = 12
@@ -180,12 +202,13 @@ def main():
     runs = obs_ledger.list_runs(ledger_dir)
     ledger_detail = {"dir": ledger_dir, "runs": len(runs)}
     mesh_detail = None
+    utilization = None
     if runs:
         events = obs_ledger.read_events(runs[-1])
         counts: dict = {}
         for ev in events:
-            name = ev.get("event", "?")
-            counts[name] = counts.get(name, 0) + 1
+            ev_name = ev.get("event", "?")
+            counts[ev_name] = counts.get(ev_name, 0) + 1
         from raft_tpu.obs import timeline as obs_timeline
 
         ledger_detail.update({
@@ -198,6 +221,22 @@ def main():
             "timeline_errors": obs_timeline.validate_trace(
                 obs_timeline.build_trace(events)),
         })
+        # roofline utilization of the warm repeat sweep: static program
+        # costs (program_cost events, RAFT_TPU_PERF above) joined with
+        # the measured dispatch->fetch walls (raft_tpu.obs.perf); on
+        # backends without cost analysis this degrades to
+        # supported=false, never an error
+        from raft_tpu.obs import perf as obs_perf
+
+        util_full = obs_perf.utilization_report(events)
+        utilization = dict(util_full["summary"])
+        utilization["device_kind"] = util_full["device"].get("kind")
+        utilization["n_devices"] = util_full["device"].get("n_devices")
+        utilization["programs"] = {
+            prog: {k: cost.get(k) for k in
+                   ("supported", "flops", "bytes_accessed", "ai",
+                    "peak_bytes")}
+            for prog, cost in util_full["programs"].items()}
         if mesh_mode:
             # mesh attribution from the warm run's plan event: the shape
             # the sweep actually built (it auto-sizes the design axis to
@@ -244,7 +283,9 @@ def main():
     result = {
         "metric": (f"{n_designs}-design x {n_case}-sea-state END-TO-END sweep wall-clock "
                    f"({name}, 200 w-bins, strip theory + aero-servo impedance, "
-                   "15-iter drag linearization, design dicts -> metrics, single chip)"),
+                   "15-iter drag linearization, design dicts -> metrics, "
+                   + (f"{len(jax.devices())}-device (design, case) mesh)"
+                      if mesh_mode else "single chip)")),
         "value": round(dt, 2),
         "unit": "s",
         "vs_baseline": round(60.0 / (dt * 1000.0 / n_designs), 3),
@@ -282,6 +323,11 @@ def main():
             # run-ledger audit of the benchmarked sweeps (schema_errors
             # must be []); render with `python -m raft_tpu.obs.report`
             "ledger": ledger_detail,
+            # roofline utilization of the warm repeat sweep (null only
+            # when no ledger was written): per-program static FLOPs /
+            # bytes / AI plus achieved rates, MFU and bound class; see
+            # docs/observability.md "Rooflines & utilization"
+            "utilization": utilization,
             # --mesh only: mesh shape + per-device throughput (null on
             # the single-chip BASELINE run)
             "mesh": mesh_detail,

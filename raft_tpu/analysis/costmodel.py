@@ -1,0 +1,218 @@
+"""Static program-cost extraction for the perf observatory.
+
+Every chunk executable the sweep builds carries its own cost model:
+XLA's ``compiled.cost_analysis()`` reports the program's FLOPs and
+bytes accessed, and ``memory_analysis()`` its peak-memory estimate —
+all computed at compile time, readable for free.  This module extracts
+those statics at the same read-only compile-service/exec-cache hook
+graftaudit uses (and at the sweep's template-memo reuse point, so warm
+runs are costed too), and emits them as ``program_cost`` ledger events
+that :mod:`raft_tpu.obs.perf` joins against measured dispatch->fetch
+wall times to produce achieved GFLOP/s, GB/s, arithmetic intensity,
+MFU, and a roofline classification.
+
+Contract (shared with graftaudit): everything here only READS an
+already-built executable — no tracing, no lowering, no XLA compile —
+and never raises into the sweep.  Backends where ``cost_analysis()``
+returns None, raises, or omits the ``flops``/``bytes accessed`` keys
+stamp ``supported=false`` on the event plus a one-time warning (the
+``emit_device_memory`` degradation pattern), never an error.
+
+Arm with ``RAFT_TPU_PERF=1`` (:func:`raft_tpu.config.perf_config`) or a
+:func:`collecting` context.
+"""
+
+from __future__ import annotations
+
+import collections
+import contextlib
+import threading
+
+from ..config import perf_config
+
+__all__ = [
+    "extract_cost", "observe_program", "observe_executables",
+    "armed", "collecting", "take_results",
+]
+
+
+def armed() -> bool:
+    """True when built executables should have their static cost read:
+    either RAFT_TPU_PERF=1 (:func:`raft_tpu.config.perf_config`) or an
+    active :func:`collecting` context."""
+    if _COLLECTING:
+        return True
+    return bool(perf_config()["enabled"])
+
+
+def extract_cost(compiled) -> dict:
+    """Static cost of one compiled executable, gracefully degraded.
+
+    Returns a dict that always carries ``supported`` (bool): True only
+    when both ``flops`` and ``bytes_accessed`` were readable.  On
+    supported backends (XLA:CPU and TPU both implement it)
+    ``cost_analysis()`` returns the properties dict of the program's
+    cost analysis — historically wrapped in a one-element list — with
+    ``'flops'`` and ``'bytes accessed'`` keys; anything else (None, a
+    raise, missing keys) lands on the degraded path with ``error`` set.
+    ``peak_bytes`` (the live-set estimate from ``memory_analysis()``)
+    is best-effort on top and never affects ``supported``.
+    """
+    out = {"supported": False, "flops": None, "bytes_accessed": None,
+           "peak_bytes": None, "error": None}
+    try:
+        ca = compiled.cost_analysis()
+        # jax has returned both a bare dict and a [dict] over versions
+        if isinstance(ca, (list, tuple)):
+            ca = ca[0] if ca else None
+        if not isinstance(ca, dict):
+            raise TypeError(f"cost_analysis() returned {type(ca).__name__}")
+        flops = ca.get("flops")
+        nbytes = ca.get("bytes accessed")
+        if not isinstance(flops, (int, float)) \
+                or not isinstance(nbytes, (int, float)):
+            raise KeyError("cost_analysis() missing 'flops'/'bytes accessed'")
+        out["flops"] = float(flops)
+        out["bytes_accessed"] = float(nbytes)
+        out["supported"] = True
+    except Exception as e:  # noqa: BLE001 - telemetry must never kill the run
+        out["error"] = f"{type(e).__name__}: {e}"
+    try:
+        from . import hlo
+
+        mem = hlo.memory_stats(compiled)
+        if mem is not None:
+            out["peak_bytes"] = int(mem.get("peak_estimate", 0)) or None
+    except Exception as e:  # noqa: BLE001 - best-effort on top of the statics
+        # peak_bytes stays None; note why without affecting `supported`
+        out.setdefault("notes", f"memory_analysis: {type(e).__name__}: {e}")
+    return out
+
+
+# ---------------------------------------------------------------------------
+# live-session collection: the compile-service / sweep hooks
+# ---------------------------------------------------------------------------
+
+_LOCK = threading.Lock()
+# bounded: an env-armed long-lived process (serve loop, many sweeps)
+# must not grow this without a consumer ever draining it
+_RESULTS = collections.deque(maxlen=256)
+_COLLECTING = 0
+
+
+@contextlib.contextmanager
+def collecting():
+    """Arm cost extraction for the duration of the context regardless of
+    the environment, collecting results for :func:`take_results`."""
+    global _COLLECTING
+    with _LOCK:
+        _COLLECTING += 1
+    try:
+        yield
+    finally:
+        with _LOCK:
+            _COLLECTING -= 1
+
+
+def take_results() -> list:
+    """Drain and return the session's accumulated ``(program, cost)``
+    pairs (compile-hook and memo-reuse observations since the last
+    drain)."""
+    with _LOCK:
+        out = list(_RESULTS)
+        _RESULTS.clear()
+    return out
+
+
+def _device_context() -> dict:
+    """Backend/device identity stamped onto every program_cost event so
+    obs.perf can pick the right device-spec row without re-probing."""
+    try:
+        import jax
+
+        dev = jax.devices()[0]
+        return {
+            "backend": jax.default_backend(),
+            "device_kind": str(getattr(dev, "device_kind", "unknown")),
+            "n_devices": len(jax.devices()),
+        }
+    except Exception:  # noqa: BLE001 - identity is decoration, not data
+        return {"backend": None, "device_kind": None, "n_devices": None}
+
+
+def _record(key, tag, cost, run=None, source="compile") -> None:
+    """File one extraction: session list + ledger event + warn-once.
+
+    With an active ledger run the cost becomes a ``program_cost`` event
+    (which also feeds the ``raft_program_*`` gauges through the standard
+    metrics mapping).  An unsupported extraction warns once per program
+    key — mirroring ``emit_device_memory`` — so a CPU-only or exotic
+    backend degrades visibly, not silently or fatally.
+    """
+    with _LOCK:
+        _RESULTS.append((str(key), dict(cost)))
+    if not cost.get("supported"):
+        from ..obs import log as obs_log
+
+        obs_log.warn_once(
+            obs_log.get_logger("analysis.costmodel"),
+            ("costmodel-unsupported", str(key)),
+            f"costmodel: program {key!r} has no readable cost analysis; "
+            "program_cost events will carry supported=false"
+            + (f" ({cost.get('error')})" if cost.get("error") else ""))
+    if run is not None and getattr(run, "enabled", False):
+        run.emit("program_cost", program=str(key), tag=str(tag),
+                 source=source, **cost, **_device_context())
+
+
+def observe_program(key, tag, lowered, compiled, run=None):
+    """Compile-service cost hook: read one built executable's statics.
+
+    Called on the compile worker thread after the build (fresh compile
+    or exec-cache load) — the same seam as
+    :func:`raft_tpu.analysis.graftaudit.observe_program`.  Reads
+    compile-time properties only and never raises: the cost model must
+    not be able to kill the sweep that triggered it.  ``lowered`` is
+    accepted for hook-signature symmetry but unused — the cost lives on
+    the compiled stage.
+    """
+    del lowered
+    try:
+        cost = extract_cost(compiled)
+        _record(key, tag, cost, run=run, source="compile")
+        return cost
+    except Exception:  # noqa: BLE001 - the hook contract: never fatal
+        from ..obs import log as obs_log
+
+        obs_log.warn_once(
+            obs_log.get_logger("analysis.costmodel"),
+            ("costmodel-observe", str(key)),
+            f"costmodel: cost extraction for program {key!r} failed; "
+            "continuing uncosted")
+        return None
+
+
+def observe_executables(executables, tag, run=None):
+    """Warm-path cost hook: cost a ``{key: compiled}`` mapping.
+
+    Repeat sweeps reuse the chunk executables straight from the
+    in-process template memo and never touch the compile service — this
+    entry point lets the sweep re-emit ``program_cost`` events for the
+    memoized pair so a warm run's ledger is as roofline-renderable as a
+    cold one's.  Same never-raises contract as :func:`observe_program`.
+    """
+    out = {}
+    for key, compiled in (executables or {}).items():
+        try:
+            cost = extract_cost(compiled)
+            _record(key, tag, cost, run=run, source="memo")
+            out[str(key)] = cost
+        except Exception:  # noqa: BLE001 - the hook contract: never fatal
+            from ..obs import log as obs_log
+
+            obs_log.warn_once(
+                obs_log.get_logger("analysis.costmodel"),
+                ("costmodel-observe", str(key)),
+                f"costmodel: cost extraction for program {key!r} failed; "
+                "continuing uncosted")
+    return out

@@ -272,6 +272,44 @@ def audit_config(overrides=None) -> dict:
 
 
 # ---------------------------------------------------------------------------
+# hardware-utilization cost models (raft_tpu.analysis.costmodel / obs.perf)
+# ---------------------------------------------------------------------------
+
+# `enabled` arms static program-cost extraction at the same read-only
+# compile-service/exec-cache hook graftaudit uses: every executable the
+# sweep compiles (or deserializes, or reuses from the template memo)
+# has its XLA cost analysis read — FLOPs, bytes accessed, peak-memory
+# estimate — and emitted as a `program_cost` ledger event, which
+# obs.perf joins against measured dispatch->fetch wall times to produce
+# achieved GFLOP/s, GB/s, arithmetic intensity, MFU, and a roofline
+# classification.  Off (the default) adds no work beyond this config
+# read per compile; arming it only READS `cost_analysis()` /
+# `memory_analysis()` on already-built executables — no tracing, no
+# extra XLA compile, bit-identical results (same contract as
+# graftaudit).  Environment override: RAFT_TPU_PERF=1.
+PERF_DEFAULTS = {
+    "enabled": False,
+}
+
+
+def perf_config(overrides=None) -> dict:
+    """Effective cost-model configuration: defaults, then environment
+    (RAFT_TPU_PERF=1), then explicit ``overrides``."""
+    import os
+
+    cfg = dict(PERF_DEFAULTS)
+    env = os.environ.get("RAFT_TPU_PERF")
+    if env is not None:
+        cfg["enabled"] = env not in ("0", "false", "")
+    if overrides:
+        unknown = set(overrides) - set(cfg)
+        if unknown:
+            raise ValueError(f"unknown perf config key(s): {sorted(unknown)}")
+        cfg.update(overrides)
+    return cfg
+
+
+# ---------------------------------------------------------------------------
 # run-ledger telemetry / trace capture (raft_tpu.obs)
 # ---------------------------------------------------------------------------
 

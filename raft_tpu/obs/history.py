@@ -123,6 +123,25 @@ def summarize_ledger(path) -> dict | None:
     if d2h:
         metrics["d2h_bytes"] = d2h
 
+    # perf observatory: utilization summary, present only when the run
+    # carried program_cost events (RAFT_TPU_PERF armed).  util_supported
+    # is 0/1 so CI can pin "the demo sweep WAS costed" absolutely;
+    # util_stall_frac / util_mfu join the rolling-median trajectory (the
+    # relative gate only fires on metrics that go UP, so stall_frac is
+    # the natural tracked one — MFU regressions show as wall_s anyway).
+    if by.get("program_cost"):
+        from . import perf as obs_perf
+
+        util = obs_perf.utilization_report(events)["summary"]
+        metrics["util_supported"] = 1 if util.get("supported") else 0
+        for src, dst in (("achieved_gflops", "util_achieved_gflops"),
+                         ("achieved_gbps", "util_achieved_gbps"),
+                         ("ai", "util_ai"),
+                         ("mfu", "util_mfu"),
+                         ("stall_frac", "util_stall_frac")):
+            if isinstance(util.get(src), (int, float)):
+                metrics[dst] = round(float(util[src]), 6)
+
     phase_totals = {ev["name"]: ev.get("total")
                     for ev in by.get("phase_stats", ())
                     if ev.get("name") is not None}
@@ -156,6 +175,21 @@ def summarize_bench(obj, path="") -> dict | None:
             metrics[key] = detail[key]
     if isinstance(detail.get("repeat_xla_compiles"), int):
         metrics["real_compiles"] = detail["repeat_xla_compiles"]
+    mesh = detail.get("mesh")
+    if isinstance(mesh, dict) and isinstance(
+            mesh.get("designs_per_sec_per_device"), (int, float)):
+        metrics["designs_per_sec_per_device"] = \
+            mesh["designs_per_sec_per_device"]
+    util = detail.get("utilization")
+    if isinstance(util, dict):
+        metrics["util_supported"] = 1 if util.get("supported") else 0
+        for src, dst in (("achieved_gflops", "util_achieved_gflops"),
+                         ("achieved_gbps", "util_achieved_gbps"),
+                         ("ai", "util_ai"),
+                         ("mfu", "util_mfu"),
+                         ("stall_frac", "util_stall_frac")):
+            if isinstance(util.get(src), (int, float)):
+                metrics[dst] = round(float(util[src]), 6)
     fingerprint = {"bench_metric": obj.get("metric")}
     return {
         "schema": SCHEMA,

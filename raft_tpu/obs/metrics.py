@@ -315,6 +315,11 @@ _ITER_BUCKETS = (1.0, 2.0, 3.0, 4.0, 5.0, 6.0, 8.0, 10.0, 12.0, 15.0,
 _RESID_BUCKETS = (1e-12, 1e-10, 1e-8, 1e-7, 1e-6, 1e-5, 1e-4, 1e-3,
                   1e-2, 1e-1, 1.0)
 
+# utilization buckets: achieved FLOP/s spans laptop-CPU demo sweeps
+# (~1e8) to multi-chip TPU pods (~1e15); MFU is a fraction of peak
+_FLOPS_BUCKETS = tuple(10.0 ** e for e in range(7, 16))
+_MFU_BUCKETS = (0.001, 0.003, 0.01, 0.03, 0.1, 0.2, 0.4, 0.6, 0.8, 1.0)
+
 # chunk-loop profiling leaves whose durations become the stage-latency
 # histogram (the full phase name is "sweep/chunks/<stage>" on the main
 # thread, "checkpoint_write" / "compile/<key>" on workers)
@@ -439,6 +444,38 @@ class _Std:
         self.watchdog_overdue = g(
             "raft_watchdog_overdue",
             "1 while some chunk is past its watchdog deadline")
+        # perf observatory (raft_tpu.analysis.costmodel + obs.perf):
+        # per-program compile-time statics + per-chunk achieved rates
+        self.program_flops = g(
+            "raft_program_flops",
+            "Static FLOPs of one chunk executable (cost_analysis)",
+            ("program",))
+        self.program_bytes = g(
+            "raft_program_bytes_accessed",
+            "Static bytes accessed by one chunk executable "
+            "(cost_analysis)", ("program",))
+        self.arithmetic_intensity = g(
+            "raft_arithmetic_intensity",
+            "Chunk FLOPs / bytes accessed (sum over chunk executables)")
+        self.achieved_flops = g(
+            "raft_achieved_flops",
+            "Achieved FLOP/s of the last fetched chunk "
+            "(static FLOPs / dispatch->fetch wall)")
+        self.achieved_bandwidth = g(
+            "raft_achieved_bandwidth_bytes",
+            "Achieved bytes/s of the last fetched chunk "
+            "(static bytes accessed / dispatch->fetch wall)")
+        self.mfu = g(
+            "raft_mfu",
+            "Model FLOPs utilization of the last fetched chunk vs the "
+            "device-spec peak (absent when the peak is unknown)")
+        self.chunk_achieved_flops = h(
+            "raft_chunk_achieved_flops",
+            "Per-chunk achieved FLOP/s distribution", _FLOPS_BUCKETS)
+        self.chunk_mfu = h(
+            "raft_chunk_mfu",
+            "Per-chunk MFU distribution (device peak known only)",
+            _MFU_BUCKETS)
 
 
 _STD = None
@@ -484,7 +521,11 @@ def status_snapshot() -> dict:
     progress, live ETA straight from the ledger's ``chunk_commit``
     accounting, health-code tallies) or ``active: null``."""
     with _STATE_LOCK:
-        active = dict(_ACTIVE) if _ACTIVE is not None else None
+        # "_"-prefixed keys are cross-event scratch (in-flight dispatch
+        # stamps, accumulated program costs), not part of the payload
+        active = ({k: v for k, v in _ACTIVE.items()
+                   if not k.startswith("_")}
+                  if _ACTIVE is not None else None)
     if active is not None:
         active["elapsed_s"] = round(time.time() - active["t_start"], 3)
     return {
@@ -520,6 +561,90 @@ def observe_event(event, rec) -> None:
 
             logging.getLogger("raft_tpu.obs.metrics").warning(
                 "metrics observe_event failed for %r", event, exc_info=True)
+
+
+def _observe_program_cost(m, rec):
+    """``program_cost`` -> static gauges + per-run cost state.
+
+    Accumulates the active run's per-program statics under
+    ``_ACTIVE["_perf"]`` so chunk fetches can be turned into achieved
+    rates, and keeps the chunk-level arithmetic intensity gauge (sum of
+    the supported executables' FLOPs over their bytes) current.
+    """
+    prog = str(rec.get("program", "?"))
+    supported = bool(rec.get("supported"))
+    if supported:
+        m.program_flops.set(float(rec.get("flops") or 0.0), program=prog)
+        m.program_bytes.set(float(rec.get("bytes_accessed") or 0.0),
+                            program=prog)
+    chunk_flops = chunk_bytes = 0.0
+    with _STATE_LOCK:
+        if _ACTIVE is None:
+            return
+        perf_state = _ACTIVE.setdefault("_perf", {"programs": {}})
+        perf_state["programs"][prog] = {
+            "supported": supported,
+            "flops": rec.get("flops"),
+            "bytes_accessed": rec.get("bytes_accessed"),
+        }
+        for key in ("device_kind", "n_devices"):
+            if rec.get(key) is not None:
+                perf_state[key] = rec[key]
+        costed = [p for p in perf_state["programs"].values()
+                  if p["supported"]]
+        chunk_flops = sum(p["flops"] for p in costed)
+        chunk_bytes = sum(p["bytes_accessed"] for p in costed)
+        perf_state["chunk_flops"] = chunk_flops or None
+        perf_state["chunk_bytes"] = chunk_bytes or None
+    if chunk_flops and chunk_bytes:
+        m.arithmetic_intensity.set(chunk_flops / chunk_bytes)
+
+
+def _observe_utilization(m, rec):
+    """``chunk_fetch`` -> achieved-rate gauges + the /status block.
+
+    Joins the fetch timestamp against the chunk's recorded dispatch
+    timestamp and the run's accumulated program costs; a run without
+    ``program_cost`` events (perf off, or an unsupported backend) takes
+    the early return and costs one dict lookup.
+    """
+    wall = perf_state = None
+    with _STATE_LOCK:
+        if _ACTIVE is not None:
+            t0 = _ACTIVE.get("_dispatch_t", {}).pop(rec.get("chunk"), None)
+            if isinstance(t0, (int, float)) \
+                    and isinstance(rec.get("t"), (int, float)):
+                wall = float(rec["t"]) - float(t0)
+            perf_state = _ACTIVE.get("_perf")
+    if not (wall and wall > 0 and perf_state
+            and perf_state.get("chunk_flops")):
+        return
+    flops = float(perf_state["chunk_flops"])
+    nbytes = float(perf_state.get("chunk_bytes") or 0.0)
+    achieved = flops / wall
+    m.achieved_flops.set(achieved)
+    m.chunk_achieved_flops.observe(achieved)
+    if nbytes:
+        m.achieved_bandwidth.set(nbytes / wall)
+    util = {
+        "achieved_gflops": round(achieved / 1e9, 3),
+        "achieved_gbps": round(nbytes / wall / 1e9, 3) if nbytes else None,
+        "ai": round(flops / nbytes, 3) if nbytes else None,
+        "device_kind": perf_state.get("device_kind"),
+        "mfu": None,
+    }
+    from . import perf as obs_perf
+
+    spec = obs_perf.device_spec(perf_state.get("device_kind"))
+    if spec is not None:
+        peak = spec["peak_flops"] * int(perf_state.get("n_devices") or 1)
+        mfu = achieved / peak
+        m.mfu.set(mfu)
+        m.chunk_mfu.observe(mfu)
+        util["mfu"] = round(mfu, 6)
+    with _STATE_LOCK:
+        if _ACTIVE is not None:
+            _ACTIVE["utilization"] = util
 
 
 def _inc_transfer(m, rec, direction):
@@ -585,8 +710,13 @@ def _observe(event, rec):
                 if devices:
                     _ACTIVE["per_device_in_flight"] = {
                         str(d): in_flight for d in devices}
+                # dispatch timestamp, joined against chunk_fetch to turn
+                # the static program costs into achieved rates
+                _ACTIVE.setdefault("_dispatch_t", {})[
+                    rec.get("chunk")] = rec.get("t")
     elif event == "chunk_fetch":
         _inc_transfer(m, rec, "d2h")
+        _observe_utilization(m, rec)
     elif event == "chunk_commit":
         m.chunks_committed.inc()
         m.watchdog_overdue.set(0)
@@ -664,6 +794,8 @@ def _observe(event, rec):
         m.replay_bundles.inc()
     elif event == "audit_finding":
         m.audit_findings.inc(rule=rec.get("rule", "?"))
+    elif event == "program_cost":
+        _observe_program_cost(m, rec)
     elif event == "chaos_inject":
         m.chaos_injections.inc(seam=rec.get("seam", "?"))
     elif event == "chunk_timeout":

@@ -1,0 +1,260 @@
+"""Roofline utilization: join static program costs with measured walls.
+
+:mod:`raft_tpu.analysis.costmodel` stamps each chunk executable's
+compile-time cost (FLOPs, bytes accessed, peak bytes) into the run
+ledger as ``program_cost`` events; the ledger already carries measured
+dispatch->fetch wall times and transfer bytes.  This module joins the
+two against a per-backend device-spec table (peak FLOP/s and HBM GB/s
+per TPU generation; honest "unknown" on CPU) to answer the north-star
+question continuously instead of once per paper: what fraction of the
+hardware's roofline does the sweep actually achieve?
+
+Outputs per run: per-program statics (FLOPs, bytes, arithmetic
+intensity), per-chunk and whole-run achieved GFLOP/s and GB/s, MFU
+(achieved / peak, when the peak is known), pipeline-stall accounting
+(the fraction of the chunk phase with NO chunk in flight, from the
+same dispatch/fetch spans), and a roofline classification:
+
+* ``compute-bound``   — arithmetic intensity at or above the machine
+  balance point (peak FLOP/s / peak bytes/s);
+* ``bandwidth-bound`` — below it;
+* ``pipeline-stall``  — whatever the statics say, the devices sat idle
+  for most of the chunk phase (host-side gaps dominate);
+* ``unknown``         — no device-spec row for this hardware (CPU, new
+  TPU generations): achieved rates are still reported, the
+  classification honestly is not.
+
+Consumed by ``obs.report`` (the "Roofline" section), ``obs.timeline``
+(straggler efficiency annotations), ``obs.history`` (``util_*``
+metrics CI tracks), ``obs.metrics`` (``raft_mfu`` & friends), and
+``bench.py`` (``detail.utilization``).
+"""
+
+from __future__ import annotations
+
+__all__ = ["DEVICE_SPECS", "device_spec", "utilization_report"]
+
+# Peak dense-matmul throughput (bf16, FLOP/s) and HBM bandwidth
+# (bytes/s) per **JAX device** — the unit the mesh shards over — from
+# the public per-chip numbers (Google Cloud TPU system architecture
+# docs / TPU papers).  v2/v3 expose each TensorCore as its own JAX
+# device (two per chip), so those rows are per-core halves; v4 onward
+# is one (megacore) device per chip.  Caveats (documented in
+# docs/observability.md): these are bf16 peaks — f32-heavy programs
+# can never reach MFU 1.0 against them — and XLA's ``bytes accessed``
+# is program traffic, not DRAM traffic, so achieved GB/s is an upper
+# bound on true HBM pressure.  Keys are matched as prefixes of the
+# lower-cased ``device_kind`` string, longest first.
+DEVICE_SPECS = {
+    "tpu v2": {"peak_flops": 22.5e12, "peak_bw": 300e9},
+    "tpu v3": {"peak_flops": 61.5e12, "peak_bw": 450e9},
+    "tpu v4": {"peak_flops": 275e12, "peak_bw": 1228e9},
+    "tpu v5 lite": {"peak_flops": 197e12, "peak_bw": 819e9},
+    "tpu v5e": {"peak_flops": 197e12, "peak_bw": 819e9},
+    "tpu v5p": {"peak_flops": 459e12, "peak_bw": 2765e9},
+    "tpu v5": {"peak_flops": 459e12, "peak_bw": 2765e9},
+    "tpu v6 lite": {"peak_flops": 918e12, "peak_bw": 1640e9},
+    "tpu v6e": {"peak_flops": 918e12, "peak_bw": 1640e9},
+}
+
+
+def device_spec(device_kind) -> dict | None:
+    """Peak FLOP/s + bytes/s row for a ``device_kind`` string, or None.
+
+    None is the honest fallback (CPU, unknown TPU generation): achieved
+    rates stay reportable, utilization-against-peak does not.
+    """
+    if not device_kind:
+        return None
+    kind = str(device_kind).strip().lower()
+    for key in sorted(DEVICE_SPECS, key=len, reverse=True):
+        if kind.startswith(key):
+            return dict(DEVICE_SPECS[key], kind=key)
+    return None
+
+
+def _interval_union(spans) -> float:
+    """Total length covered by a list of (start, stop) intervals."""
+    total = 0.0
+    last_stop = None
+    for start, stop in sorted(spans):
+        if stop <= start:
+            continue
+        if last_stop is None or start >= last_stop:
+            total += stop - start
+            last_stop = stop
+        elif stop > last_stop:
+            total += stop - last_stop
+            last_stop = stop
+    return total
+
+
+def utilization_report(events) -> dict:
+    """Roofline utilization of one run, from its ledger events alone.
+
+    Returns a dict with ``supported`` (any program carried readable
+    cost statics), ``programs`` (per-program FLOPs / bytes / AI /
+    peak-bytes), ``device`` (backend, kind, device count, spec row or
+    None), ``chunks`` (per-chunk wall + achieved rates + bound class),
+    ``per_device`` (fetch-byte shares), and ``summary`` (whole-run
+    achieved GFLOP/s, GB/s, arithmetic intensity, MFU, stall fraction,
+    bound classification).  All rates are computed over the chunk-phase
+    span (first dispatch -> last fetch), which is the pipelined-overlap
+    honest denominator; per-chunk rates use each chunk's own
+    dispatch->fetch wall and therefore over-attribute under deep
+    pipelining — they exist for relative comparison, not absolutes.
+    """
+    programs: dict = {}
+    device = {"backend": None, "kind": None, "n_devices": None}
+    dispatch: dict = {}
+    chunks = []
+    fetch_bytes_total = 0
+    per_device_bytes: dict = {}
+    plan_devices = None
+
+    for ev in events:
+        name = ev.get("event")
+        if name == "program_cost":
+            prog = str(ev.get("program"))
+            programs[prog] = {
+                "supported": bool(ev.get("supported")),
+                "flops": ev.get("flops"),
+                "bytes_accessed": ev.get("bytes_accessed"),
+                "peak_bytes": ev.get("peak_bytes"),
+                "source": ev.get("source"),
+                "error": ev.get("error"),
+            }
+            for key in ("backend", "n_devices"):
+                if ev.get(key) is not None:
+                    device[key] = ev[key]
+            if ev.get("device_kind") is not None:
+                device["kind"] = ev["device_kind"]
+        elif name == "plan":
+            plan_devices = ev.get("devices")
+        elif name == "chunk_dispatch":
+            dispatch[ev.get("chunk")] = ev
+        elif name == "chunk_fetch":
+            fetch_bytes_total += int(ev.get("bytes") or 0)
+            for d, b in (ev.get("per_device") or {}).items():
+                per_device_bytes[str(d)] = (per_device_bytes.get(str(d), 0)
+                                            + int(b))
+            disp = dispatch.get(ev.get("chunk"))
+            if disp is not None and isinstance(ev.get("t"), (int, float)) \
+                    and isinstance(disp.get("t"), (int, float)):
+                chunks.append({"chunk": ev.get("chunk"),
+                               "t_dispatch": float(disp["t"]),
+                               "t_fetch": float(ev["t"]),
+                               "wall_s": float(ev["t"]) - float(disp["t"]),
+                               "n_real": disp.get("n_real")})
+
+    if plan_devices:
+        device["n_devices"] = len(plan_devices)
+    n_devices = int(device["n_devices"] or 1)
+    spec = device_spec(device["kind"])
+    device["spec"] = spec
+
+    # per-program arithmetic intensity (a compile-time constant)
+    for cost in programs.values():
+        f, b = cost.get("flops"), cost.get("bytes_accessed")
+        cost["ai"] = (f / b if isinstance(f, (int, float))
+                      and isinstance(b, (int, float)) and b else None)
+
+    supported_costs = [c for c in programs.values() if c["supported"]]
+    supported = bool(supported_costs)
+    # one chunk dispatch executes every chunk executable once (partA ->
+    # partB), so a chunk's static cost is the sum over programs
+    chunk_flops = sum(c["flops"] for c in supported_costs)
+    chunk_bytes = sum(c["bytes_accessed"] for c in supported_costs)
+    ai = chunk_flops / chunk_bytes if chunk_bytes else None
+
+    # chunk-phase span + busy/stall split from the dispatch->fetch spans
+    summary: dict = {
+        "supported": supported,
+        "n_programs": len(programs),
+        "n_programs_supported": len(supported_costs),
+        "n_chunks": len(chunks),
+        "chunk_flops": chunk_flops or None,
+        "chunk_bytes": chunk_bytes or None,
+        "ai": ai,
+        "d2h_bytes": fetch_bytes_total or None,
+    }
+    peak_flops = spec["peak_flops"] * n_devices if spec else None
+    peak_bw = spec["peak_bw"] * n_devices if spec else None
+    if chunks:
+        spans = [(c["t_dispatch"], c["t_fetch"]) for c in chunks]
+        span_s = max(s[1] for s in spans) - min(s[0] for s in spans)
+        busy_s = _interval_union(spans)
+        stall_s = max(0.0, span_s - busy_s)
+        summary.update({
+            "span_s": round(span_s, 6),
+            "busy_s": round(busy_s, 6),
+            "stall_s": round(stall_s, 6),
+            "stall_frac": round(stall_s / span_s, 4) if span_s > 0 else None,
+        })
+        if supported and span_s > 0:
+            total_flops = chunk_flops * len(chunks)
+            total_bytes = chunk_bytes * len(chunks)
+            achieved_flops = total_flops / span_s
+            achieved_bw = total_bytes / span_s
+            summary.update({
+                "total_flops": total_flops,
+                "total_bytes": total_bytes,
+                "achieved_flops": achieved_flops,
+                "achieved_gflops": round(achieved_flops / 1e9, 3),
+                "achieved_bw": achieved_bw,
+                "achieved_gbps": round(achieved_bw / 1e9, 3),
+                "achieved_flops_per_device":
+                    achieved_flops / n_devices,
+            })
+            if spec:
+                summary["mfu"] = round(achieved_flops / peak_flops, 6)
+                summary["bw_frac"] = round(achieved_bw / peak_bw, 6)
+        summary["bound"] = _classify(summary, spec)
+
+    for c in chunks:
+        wall = c["wall_s"]
+        if supported and wall > 0:
+            c["achieved_flops"] = chunk_flops / wall
+            c["achieved_bw"] = chunk_bytes / wall
+            if spec:
+                c["mfu"] = round(c["achieved_flops"] / peak_flops, 6)
+                c["bw_frac"] = round(c["achieved_bw"] / peak_bw, 6)
+                c["bound"] = ("compute" if c["mfu"] >= c["bw_frac"]
+                              else "bandwidth")
+            else:
+                c["bound"] = "unknown"
+
+    total_pd = sum(per_device_bytes.values())
+    per_device = {
+        d: {"fetch_bytes": b,
+            "share": round(b / total_pd, 4) if total_pd else 0.0}
+        for d, b in sorted(per_device_bytes.items(), key=lambda kv: kv[0])
+    }
+
+    return {
+        "supported": supported,
+        "programs": programs,
+        "device": device,
+        "chunks": chunks,
+        "per_device": per_device,
+        "summary": summary,
+    }
+
+
+# a run whose devices sat idle for more than half the chunk phase is
+# stall-dominated no matter what the statics say about its programs
+_STALL_BOUND_FRAC = 0.5
+
+
+def _classify(summary, spec) -> str:
+    """Roofline bound class of a whole run."""
+    stall = summary.get("stall_frac")
+    if isinstance(stall, (int, float)) and stall > _STALL_BOUND_FRAC:
+        return "pipeline-stall"
+    if not spec or not summary.get("supported"):
+        return "unknown"
+    ai = summary.get("ai")
+    if not isinstance(ai, (int, float)):
+        return "unknown"
+    balance = spec["peak_flops"] / spec["peak_bw"]
+    return "compute" if ai >= balance else "bandwidth"

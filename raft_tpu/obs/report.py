@@ -230,6 +230,49 @@ def render(events):
             lines.append(f"  device {d}: {_fmt_bytes(b)} fetched "
                          f"({frac:6.1%})  |{_bar(frac)}|")
 
+    # ---- roofline / utilization (perf observatory) ------------------------
+    if by.get("program_cost"):
+        from . import perf as _perf
+
+        util = _perf.utilization_report(events)
+        lines += _section("roofline")
+        dev = util["device"]
+        spec = dev.get("spec")
+        lines.append(
+            f"device   {dev.get('kind') or '?'} x{dev.get('n_devices') or 1}"
+            f" ({dev.get('backend') or '?'})  peak "
+            + (f"{spec['peak_flops'] / 1e12:.1f} TFLOP/s, "
+               f"{spec['peak_bw'] / 1e9:.0f} GB/s per device"
+               if spec else "unknown (no device-spec row; MFU unavailable)"))
+        lines.append(f"{'program':<10}{'flops':>14}{'bytes':>12}"
+                     f"{'AI':>8}  {'peak_bytes':>10}  source")
+        for prog, cost in sorted(util["programs"].items()):
+            if cost["supported"]:
+                lines.append(
+                    f"{prog:<10}{cost['flops']:>14,.0f}"
+                    f"{_fmt_bytes(cost['bytes_accessed']):>12}"
+                    f"{cost['ai']:>8.2f}  "
+                    f"{_fmt_bytes(cost['peak_bytes']):>10}  "
+                    f"{cost.get('source') or '?'}")
+            else:
+                lines.append(f"{prog:<10}  unsupported "
+                             f"({cost.get('error') or 'no cost analysis'})")
+        s = util["summary"]
+        if s.get("achieved_flops") is not None:
+            achieved = (f"achieved {s['achieved_gflops']:,.1f} GFLOP/s, "
+                        f"{s['achieved_gbps']:,.1f} GB/s over "
+                        f"{s['n_chunks']} chunk(s) in {s['span_s']:.3f} s")
+            if s.get("mfu") is not None:
+                achieved += (f"; MFU {s['mfu']:.2%} "
+                             f"|{_bar(min(1.0, s['mfu']))}|")
+            lines.append(achieved)
+        if s.get("stall_frac") is not None:
+            lines.append(
+                f"pipeline {s['busy_s']:.3f} s busy / {s['stall_s']:.3f} s "
+                f"stalled ({s['stall_frac']:.1%} of the chunk phase idle)")
+        if s.get("bound"):
+            lines.append(f"bound    {s['bound']}")
+
     # ---- convergence (flight recorder) -----------------------------------
     conv = by.get("convergence_summary", [])
     if conv:

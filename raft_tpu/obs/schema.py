@@ -105,6 +105,14 @@ EVENTS: dict[str, tuple] = {
                                                 #   violation in one built
                                                 #   executable; + value,
                                                 #   limit
+    # -- static program cost (raft_tpu.analysis.costmodel) ----------------
+    "program_cost": ("program", "supported"),   # one executable's compile-
+                                                #   time cost analysis;
+                                                #   + flops, bytes_accessed,
+                                                #   peak_bytes, tag, source
+                                                #   ('compile'|'memo'),
+                                                #   device_kind, n_devices,
+                                                #   error when degraded
     # -- persistence / phases / traces ------------------------------------
     "checkpoint_flush": ("seconds", "ok"),
     "phase": ("name", "seconds"),               # streamed per phase exit

@@ -257,13 +257,25 @@ def straggler_report(events, top=5):
     ``top`` slowest by dispatch->fetch wall seconds, each with its
     per-device byte split), and ``imbalance`` (max device share over
     mean share; 1.0 = perfectly balanced fetches).
+
+    When the ledger carries ``program_cost`` events (perf observatory
+    armed), each slow chunk is additionally annotated with WHY it was
+    slow: its roofline ``bound`` class and MFU from
+    :func:`raft_tpu.obs.perf.utilization_report`, and ``idle_s`` — the
+    host-side gap between the previous fetch and this dispatch, which
+    separates slow-because-bandwidth-bound from slow-because-idle — and
+    the report grows a ``utilization`` summary.
     """
     dispatch = {}
     per_dev_total: dict = {}
     chunk_walls = []
+    has_costs = False
+    last_fetch_t = None
     for ev in events:
         name = ev.get("event")
-        if name == "chunk_dispatch":
+        if name == "program_cost":
+            has_costs = True
+        elif name == "chunk_dispatch":
             dispatch[ev.get("chunk")] = ev
         elif name == "chunk_fetch":
             disp = dispatch.get(ev.get("chunk"))
@@ -272,12 +284,20 @@ def straggler_report(events, top=5):
             for d, b in per_dev.items():
                 per_dev_total[d] = per_dev_total.get(d, 0) + b
             if disp is not None:
+                # idle_s: with pipeline_depth 1 this chunk's dispatch can
+                # start no earlier than the previous fetch; a positive gap
+                # is host time the devices spent idle, not device slowness
+                idle = (max(0.0, float(disp["t"]) - last_fetch_t)
+                        if last_fetch_t is not None else 0.0)
                 chunk_walls.append({
                     "chunk": ev.get("chunk"),
                     "wall_s": float(ev["t"]) - float(disp["t"]),
+                    "idle_s": round(idle, 6),
                     "n_real": disp.get("n_real"),
                     "per_device": per_dev,
                 })
+            last_fetch_t = float(ev["t"]) if isinstance(
+                ev.get("t"), (int, float)) else last_fetch_t
     total = sum(per_dev_total.values())
     devices = {
         d: {"bytes": b, "share": (b / total if total else 0.0)}
@@ -287,8 +307,19 @@ def straggler_report(events, top=5):
     imbalance = (max(shares) / (sum(shares) / len(shares))
                  if shares and sum(shares) else 1.0)
     chunk_walls.sort(key=lambda c: -c["wall_s"])
-    return {"devices": devices, "chunks": chunk_walls[:top],
-            "imbalance": imbalance}
+    report = {"devices": devices, "chunks": chunk_walls[:top],
+              "imbalance": imbalance, "utilization": None}
+    if has_costs:
+        from . import perf as obs_perf
+
+        util = obs_perf.utilization_report(events)
+        by_chunk = {c.get("chunk"): c for c in util["chunks"]}
+        for c in report["chunks"]:
+            uc = by_chunk.get(c["chunk"]) or {}
+            c["bound"] = uc.get("bound")
+            c["mfu"] = uc.get("mfu")
+        report["utilization"] = util["summary"]
+    return report
 
 
 def format_stragglers(report):
@@ -304,8 +335,27 @@ def format_stragglers(report):
     if report["chunks"]:
         lines.append("  slowest chunks (dispatch->fetch):")
         for c in report["chunks"]:
-            lines.append(f"    chunk {c['chunk']}: {c['wall_s']*1e3:8.1f} ms "
-                         f"({c['n_real']} designs)")
+            line = (f"    chunk {c['chunk']}: {c['wall_s']*1e3:8.1f} ms "
+                    f"({c['n_real']} designs)")
+            # perf-observatory annotation: slow because the devices were
+            # genuinely loaded (bound class + MFU) or because they sat
+            # idle waiting on the host (idle_s dominates the wall)
+            if c.get("bound"):
+                line += f"  [{c['bound']}"
+                if c.get("mfu") is not None:
+                    line += f", mfu {c['mfu']:.2%}"
+                if c.get("idle_s"):
+                    line += f", idle {c['idle_s']*1e3:.1f} ms before dispatch"
+                line += "]"
+            lines.append(line)
+    util = report.get("utilization")
+    if util:
+        line = f"  run bound: {util.get('bound', '?')}"
+        if util.get("mfu") is not None:
+            line += f" (MFU {util['mfu']:.2%})"
+        if util.get("stall_frac") is not None:
+            line += f", {util['stall_frac']:.1%} of the chunk phase stalled"
+        lines.append(line)
     return "\n".join(lines)
 
 

@@ -40,7 +40,7 @@ import time
 import jax
 
 from .. import profiling
-from ..config import audit_config, compile_config
+from ..config import audit_config, compile_config, perf_config
 from ..obs import ledger as obs_ledger
 from ..obs import log as obs_log
 from ..obs import metrics as obs_metrics
@@ -85,6 +85,20 @@ def _audit_armed() -> bool:
     if ga is not None:
         return bool(ga.armed())
     return bool(audit_config()["enabled"])
+
+
+def _perf_armed() -> bool:
+    """Should built executables have their static cost read (costmodel)?
+
+    Same shape as :func:`_audit_armed` for the same reason: the off path
+    pays one config read, never the costmodel import, and a loaded
+    module's ``armed()`` additionally honors an active ``collecting()``
+    context on top of RAFT_TPU_PERF.
+    """
+    cm = sys.modules.get("raft_tpu.analysis.costmodel")
+    if cm is not None:
+        return bool(cm.armed())
+    return bool(perf_config()["enabled"])
 
 
 def program_hash(lowered) -> str:
@@ -389,6 +403,21 @@ class CompileService:
                             run=run)
                     except Exception:
                         _LOG.warning("graftaudit hook failed for %s",
+                                     task.key, exc_info=True)
+                # static cost model (perf observatory): reads the
+                # executable's compile-time cost/memory analyses —
+                # same read-only, never-fatal contract as graftaudit,
+                # and covers BOTH the fresh-compile and exec-cache-load
+                # paths (a deserialized executable is costed too)
+                if _perf_armed():
+                    try:
+                        from ..analysis import costmodel
+
+                        costmodel.observe_program(
+                            task.key, cache_tag, lowered, compiled,
+                            run=run)
+                    except Exception:
+                        _LOG.warning("costmodel hook failed for %s",
                                      task.key, exc_info=True)
                 if warm_args_fn is not None:
                     try:

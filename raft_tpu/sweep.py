@@ -851,6 +851,16 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             # repeat sweep: both chunk executables come straight from the
             # in-process template memo — no lowering, no XLA
             run.emit("compile_cache", cache="hit")
+            # warm runs never touch the compile service, so its costmodel
+            # hook never fires — re-emit the memoized executables' static
+            # costs here (read-only, never fatal) so a warm run's ledger
+            # is as roofline-renderable as a cold one's
+            from .parallel.compile_service import _perf_armed
+            if _perf_armed():
+                from .analysis import costmodel
+                costmodel.observe_executables(
+                    {"A": jitted[0], "B": jitted[1]},
+                    tag=repr(jit_key), run=run)
         from jax.sharding import NamedSharding, PartitionSpec as P
 
         d_sh = NamedSharding(mesh, P("design"))

@@ -2,8 +2,10 @@
 
 The reference's golden pickles were produced with the Fortran-backed
 CCBlade (tests/test_rotor.py:83 in the reference, rtol=1e-5 against its
-own binaries).  Our BEM is an independent implementation; agreement
-levels, documented per-channel below, are:
+own binaries), one pickle per nacelle-yaw mode
+(``IEA15MW_true_calcAero-yaw_mode{0..3}.pkl``).  Our BEM is an
+independent implementation; agreement levels, documented per-channel
+below, are:
 
 - thrust T, torque Q, power, and the aero damping derivative dT/dU:
   1.5-4.8% (uniform offset; polar-spline / loss-model differences)
@@ -29,12 +31,22 @@ levels, documented per-channel below, are:
   The residual factor therefore lives in the Fortran CCBlade's
   asymmetry response itself (not reproducible bit-for-bit without its
   source, which this environment lacks);
-  ``test_cross_axis_response_bands`` locks the measured ratios so any
+  ``test_cross_axis_response_bands`` locks the measured ratios PER
+  YAW MODE — tightened to the measured +18..+27% window on the
+  axisymmetric-rig mode 0, and at the documented +10..+30% window on
+  the yawed-inflow modes 1-3, whose goldens exercise the
+  heading-dependent asymmetry terms mode 0 never reaches — so any
   regression OR improvement is flagged.
+
+The whole module degrades to SKIP (not error) when the reference
+checkout's test-data tree is absent: the goldens are CCBlade artifacts
+we cannot regenerate, not files this repo ships.
 """
 
-import numpy as np
+import os
 import pickle
+
+import numpy as np
 import pytest
 import yaml
 
@@ -43,13 +55,25 @@ from raft_tpu.rotor.rotor import Rotor
 
 TEST_DATA = "/root/reference/tests/test_data"
 
+pytestmark = pytest.mark.skipif(
+    not os.path.isdir(TEST_DATA),
+    reason=f"reference CCBlade golden data not present ({TEST_DATA})")
 
-@pytest.fixture(scope="module")
-def iea15mw_rotor():
+# measured cross-axis agreement bands per yaw mode (see module
+# docstring): mode 0 is the fully-characterized axisymmetric rig; the
+# yawed modes 1-3 carry the documented round-5 window until their
+# asymmetry response is forensically tightened too
+_MY_BANDS = {0: (1.18, 1.27), 1: (1.10, 1.30), 2: (1.10, 1.30),
+             3: (1.10, 1.30)}
+_MZ_SCALE = {0: 0.25, 1: 0.30, 2: 0.30, 3: 0.30}
+
+
+def _build_rotor(yaw_mode=0):
     with open(f"{TEST_DATA}/IEA15MW.yaml") as f:
         design = yaml.load(f, Loader=yaml.FullLoader)
     t = design["turbine"]
     t["nrotors"] = 1
+    t["yaw_mode"] = yaw_mode
     if isinstance(t.get("tower"), dict):
         t["tower"] = [t["tower"]]
     for k, d in [("rho_air", 1.225), ("mu_air", 1.81e-05), ("shearExp_air", 0.12),
@@ -64,10 +88,23 @@ def iea15mw_rotor():
     return rotor
 
 
+def _load_gold(yaw_mode):
+    path = f"{TEST_DATA}/IEA15MW_true_calcAero-yaw_mode{yaw_mode}.pkl"
+    if not os.path.exists(path):
+        pytest.skip(f"golden pickle for yaw_mode{yaw_mode} not shipped "
+                    f"({path})")
+    with open(path, "rb") as f:
+        return pickle.load(f)
+
+
+@pytest.fixture(scope="module")
+def iea15mw_rotor():
+    return _build_rotor(yaw_mode=0)
+
+
 @pytest.fixture(scope="module")
 def gold_mode0():
-    with open(f"{TEST_DATA}/IEA15MW_true_calcAero-yaw_mode0.pkl", "rb") as f:
-        return pickle.load(f)
+    return _load_gold(0)
 
 
 def test_calcAero_thrust_torque_parity(iea15mw_rotor, gold_mode0):
@@ -113,38 +150,48 @@ def test_calcAero_turbulent_excitation(iea15mw_rotor, gold_mode0):
     assert checked > 0
 
 
-def test_cross_axis_response_bands(iea15mw_rotor, gold_mode0):
+@pytest.mark.parametrize("yaw_mode", [0, 1, 2, 3])
+def test_cross_axis_response_bands(yaw_mode):
     """Regression-lock the cross-axis hub-load ratios vs the CCBlade
-    goldens, decomposed in the rotor (CC) frame.
+    goldens, decomposed in the rotor (CC) frame, per nacelle-yaw mode.
 
     The golden ``f_aero0`` is ``R_q @ [T,Y,Z]`` / ``R_q @ [My,Q,Mz]``
     (the reference's moments_axis ordering, raft_rotor.py:841-847), so
     applying ``R_q.T`` recovers CCBlade's own hub-frame channels.  The
-    bands encode the measured round-5 agreement (see module docstring);
-    tighten them when the asymmetry-response gap closes.
+    bands encode the measured agreement (module docstring): mode 0's
+    are tightened to the characterized +18..+27% My window; modes 1-3
+    (``yaw_mode1-3`` goldens: heading-following / commanded-yaw
+    inflow) hold the documented +10..+30% window.  R_q is re-read per
+    case because yawed modes rotate the shaft frame with the case
+    heading.  Tighten further when the asymmetry-response gap closes.
     """
-    rotor = iea15mw_rotor
-    Rq = np.asarray(rotor.R_q)
+    rotor = _build_rotor(yaw_mode=yaw_mode)
+    gold = _load_gold(yaw_mode)
+    my_lo, my_hi = _MY_BANDS[yaw_mode]
     checked = 0
-    for entry in gold_mode0:
+    for entry in gold:
         c = entry["case"]
-        if c["turbulence"] != 0 or c["wind_heading"] != 0:
+        if c["turbulence"] != 0:
+            continue
+        if yaw_mode == 0 and c.get("wind_heading", 0) != 0:
             continue
         f0, _, _, _ = rotor.calcAero(c)
+        Rq = np.asarray(rotor.R_q)  # per-case: setYaw ran inside calcAero
         F_cc = Rq.T @ np.asarray(f0[:3])
         M_cc = Rq.T @ np.asarray(f0[3:])
         gF = Rq.T @ entry["f_aero0"][:3]
         gM = Rq.T @ entry["f_aero0"][3:]
         T, My, Q, Mz = F_cc[0], M_cc[0], M_cc[1], M_cc[2]
         gT, gMy, gQ, gMz = gF[0], gM[0], gM[1], gM[2]
-        # uniform-response channels: tight
-        assert abs(T / gT - 1.0) < 0.05, (c, T, gT)
-        assert abs(Q / gQ - 1.0) < 0.05, (c, Q, gQ)
+        # uniform-response channels: tight on every yaw mode
+        assert abs(T / gT - 1.0) < 0.05, (yaw_mode, c, T, gT)
+        assert abs(Q / gQ - 1.0) < 0.05, (yaw_mode, c, Q, gQ)
         # asymmetry-response channels: locked at the measured ratios
-        assert 1.10 < My / gMy < 1.30, (c, My, gMy)
+        assert my_lo < My / gMy < my_hi, (yaw_mode, c, My, gMy)
         # Mz crosses zero near rated wind speed, so a ratio band is
         # ill-posed; bound its error by the dominant cross-axis scale
-        assert abs(Mz - gMz) < 0.30 * abs(gMy), (c, Mz, gMz, gMy)
+        assert abs(Mz - gMz) < _MZ_SCALE[yaw_mode] * abs(gMy), \
+            (yaw_mode, c, Mz, gMz, gMy)
         checked += 1
     assert checked >= 6
 
