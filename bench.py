@@ -27,6 +27,162 @@ import time
 import numpy as np
 
 
+def main_bem():
+    """--bem: benchmark the batched potential-flow BEM tier
+    (raft_tpu/hydro/bem_batch.py) at sweep scale.
+
+    Prints ONE JSON line of the same shape as the main bench.  The
+    baseline is the thing the tier replaced: the host-NumPy one-design-
+    at-a-time ``fowt.calcBEM`` solve — ``vs_baseline`` is the measured
+    speedup of the warm batched solve over n_designs sequential host
+    solves (extrapolated from one timed solve).
+    """
+    import jax
+
+    os.environ.setdefault("RAFT_TPU_PERF", "1")
+
+    from raft_tpu import profiling
+    from raft_tpu.config import bem_mode
+    from raft_tpu.core.model import Model
+    from raft_tpu.designs import demo_spar
+    from raft_tpu.hydro import bem_batch
+    from raft_tpu.parallel.design_batch import stack_variants
+    from raft_tpu.sweep import sweep
+
+    d = demo_spar(nw_freqs=(0.05, 0.4))
+    d["platform"]["potModMaster"] = 0
+    d["platform"]["members"][0]["potMod"] = True
+
+    n_designs = int(os.environ.get("RAFT_BENCH_BEM_DESIGNS", "8"))
+    diams = np.linspace(9.0, 10.7, n_designs)
+    axes = [("platform.members.0.d",
+             [[float(dv), float(dv), 6.5, 6.5] for dv in diams])]
+    states = [(4.0, 8.0), (6.0, 10.0, 30.0)]
+    headings = (0.0, 30.0)
+
+    model = Model(d)
+    fowt = model.fowtList[0]
+    fowt.setPosition(np.zeros(6))
+    fowt.calcStatics()
+    w = np.asarray(fowt.w)
+    k = np.asarray(fowt.k)
+
+    # baseline: ONE host solve of the base design through the
+    # pre-existing per-design path (mesh + PanelBEM inside calcBEM)
+    t0 = time.perf_counter()
+    fowt.calcBEM()
+    t_host_one = time.perf_counter() - t0
+
+    stacked, treedef, _ = stack_variants(
+        d, axes, [(v,) for v in axes[0][1]],
+        rho=fowt.rho_water, g=fowt.g, x_ref=fowt.x_ref, y_ref=fowt.y_ref,
+        heading_adjust=fowt.heading_adjust)
+
+    # host meshing split (the only per-design host work left)
+    host_leaves = [np.asarray(leaf) for leaf in stacked]
+    topos = [cm.topo for cm in fowt.memberList]
+    t0 = time.perf_counter()
+    panels = []
+    for i in range(n_designs):
+        geoms, _ = jax.tree_util.tree_unflatten(
+            treedef, [leaf[i] for leaf in host_leaves])
+        panels.append(bem_batch.mesh_variant(topos, geoms))
+    t_mesh = time.perf_counter() - t0
+    n_panels = [len(p[0]) for p in panels]
+
+    # assembly micro-bench: the Rankine + free-surface-image influence
+    # matrices for the full bucketed stack, per assembly path [ms]
+    Nmax = bem_batch._bucket_size(max(n_panels))
+    A, C, Nrm, _msk, _modes = bem_batch._stack_bucket(panels, Nmax)
+    assembly_ms = {}
+    for aname in ("jnp", "pallas"):
+        try:
+            jax.block_until_ready(
+                bem_batch.rankine_matrices_batch(C, A, Nrm, mode=aname))
+            t0 = time.perf_counter()
+            for _ in range(3):
+                jax.block_until_ready(
+                    bem_batch.rankine_matrices_batch(C, A, Nrm, mode=aname))
+            assembly_ms[aname] = round((time.perf_counter() - t0) / 3 * 1e3, 2)
+        except Exception:
+            assembly_ms[aname] = None
+
+    # the full tier: mesh -> assembly -> wave part -> batched panel
+    # solves -> A(w), B(w), X(w, heading); cold includes the compiles
+    def tier():
+        return bem_batch.solve_design_batch(
+            fowt, treedef, stacked, n_designs, w, k, headings_deg=headings)
+
+    t0 = time.perf_counter()
+    out = tier()
+    t_tier_cold = time.perf_counter() - t0
+    assert all(np.all(np.isfinite(out[key])) for key in out), "non-finite BEM"
+    t0 = time.perf_counter()
+    out = tier()
+    t_tier_warm = time.perf_counter() - t0
+
+    # end-to-end: the same pot-flow design batch through sweep() (the
+    # tier runs in the plan phase; sweep/bem is its profiling phase)
+    t0 = time.perf_counter()
+    sw = sweep(d, axes, states, n_iter=10)
+    t_sweep_cold = time.perf_counter() - t0
+    assert np.all(np.isfinite(sw["motion_std"])), "sweep non-finite"
+    profiling.reset()
+    t0 = time.perf_counter()
+    sweep(d, axes, states, n_iter=10)
+    t_sweep_warm = time.perf_counter() - t0
+    phases = profiling.report()
+
+    result = {
+        "metric": (f"{n_designs}-design batched first-order BEM "
+                   f"(radiation + diffraction, {len(w)} w-bins, "
+                   f"{len(headings)} headings, N_max {Nmax} panels, "
+                   "warm on-device solve)"),
+        "value": round(t_tier_warm, 3),
+        "unit": "s",
+        # speedup over n_designs sequential host calcBEM solves
+        "vs_baseline": round(n_designs * t_host_one / t_tier_warm, 2),
+        "detail": {
+            "backend": {
+                "platform": jax.default_backend(),
+                "device_kind": str(getattr(jax.devices()[0],
+                                           "device_kind", "?")),
+            },
+            "bem_mode": bem_mode(),
+            "assembly_path": bem_batch.assembly_choice()[0],
+            "n_designs": n_designs,
+            "nw": len(w),
+            "n_panels": {"min": min(n_panels), "max": max(n_panels),
+                         "bucket": Nmax},
+            "host_calcBEM_one_design_s": round(t_host_one, 3),
+            "tier_cold_s": round(t_tier_cold, 3),
+            "tier_warm_s": round(t_tier_warm, 3),
+            "designs_per_sec_warm": round(n_designs / t_tier_warm, 2),
+            # split: host meshing vs device assembly vs the rest of the
+            # warm tier (wave part + panel solves + excitation)
+            "mesh_host_s": round(t_mesh, 3),
+            "rankine_assembly_ms": assembly_ms,
+            "solve_s": round(
+                t_tier_warm - t_mesh
+                - (assembly_ms.get("jnp") or 0.0) / 1e3, 3),
+            "sweep_end_to_end_cold_s": round(t_sweep_cold, 2),
+            "sweep_end_to_end_warm_s": round(t_sweep_warm, 2),
+            # warm-sweep BEM precompute phase: ~0 when the template memo
+            # serves the cached coefficients (the designed steady state)
+            "sweep_bem_phase_warm_s": round(phases.get("sweep/bem", 0.0), 3),
+        },
+    }
+    print(json.dumps(result))
+
+    history_path = os.environ.get("RAFT_TPU_BENCH_HISTORY",
+                                  "bench_history.jsonl")
+    if history_path:
+        stamped = dict(result)
+        stamped["t"] = time.time()
+        with open(history_path, "a", encoding="utf-8") as fh:
+            fh.write(json.dumps(stamped) + "\n")
+
+
 def main():
     import jax
 
@@ -347,4 +503,7 @@ def main():
 
 
 if __name__ == "__main__":
-    main()
+    if "--bem" in sys.argv[1:]:
+        main_bem()
+    else:
+        main()

@@ -555,6 +555,26 @@ def smallsolve_mode() -> str:
     return mode
 
 
+# Potential-flow BEM tier (raft_tpu.hydro.bem_batch): 'off' keeps the
+# strip-theory-only sweep (potMod configs fall back per design, exactly
+# the pre-tier behaviour); 'jnp' assembles influence matrices with plain
+# jnp ops; 'pallas' forces the Pallas assembly kernel (interpret mode
+# off-TPU); 'auto' picks pallas on TPU and jnp elsewhere.
+# Override: RAFT_TPU_BEM={off,jnp,pallas,auto}.
+BEM_MODES = ("off", "jnp", "pallas", "auto")
+
+
+def bem_mode() -> str:
+    """Effective potential-flow BEM tier mode."""
+    import os
+
+    mode = os.environ.get("RAFT_TPU_BEM", "auto").strip().lower() or "auto"
+    if mode not in BEM_MODES:
+        raise ValueError(
+            f"RAFT_TPU_BEM={mode!r}: expected one of {BEM_MODES}")
+    return mode
+
+
 def enable_compilation_cache(path: str | None = None) -> str | None:
     """Turn on JAX's persistent (on-disk) compilation cache.
 

@@ -1,0 +1,703 @@
+"""Batched on-device potential-flow BEM for the design sweep (the BEM tier).
+
+:mod:`raft_tpu.hydro.potential_bem` solves one design at a time with
+host-NumPy influence-matrix assembly.  This module promotes that solver
+to sweep scale:
+
+1.  Every variant's potMod members are meshed on the host (PRP-local
+    coordinates, the vectorized :class:`~raft_tpu.hydro.mesh.PanelMesh`
+    path), masked and oriented exactly like ``PanelBEM.__init__``.
+2.  Variants are grouped into panel-count buckets (multiples of
+    ``_BUCKET`` panels); each bucket is padded to its ``N_max`` with
+    zero-area panels.  Padding is *exact*: padded columns carry
+    exactly-zero influence coefficients (every term is proportional to
+    the panel area) and padded rows are replaced by identity rows in
+    the boundary-condition system, so a design's coefficients are
+    bit-identical across bucket sizes.
+3.  The frequency-independent Rankine + free-surface-image matrices are
+    assembled on device for the whole bucket at once — either with
+    plain ``jnp`` ops or with a Pallas TPU kernel (row-blocked grid,
+    everything elementwise on the VPU).  The per-frequency wave part
+    stays in XLA: its bilinear Green-table gathers
+    (:func:`raft_tpu.hydro.greens.lookup3`) are exactly the access
+    pattern TPU Pallas handles poorly, while XLA lowers them to fast
+    one-hot contractions.
+4.  Radiation + Haskind excitation solve as one batched complex system
+    ``jnp.linalg.solve`` over [nd, nw_blk, N, N], vmapped over designs
+    and frequencies, chunked to a device-memory budget.
+
+Mode selection (``RAFT_TPU_BEM`` / :func:`raft_tpu.config.bem_mode`):
+``off`` disables the tier (the sweep falls back per design exactly like
+the pre-tier code), ``jnp``/``pallas`` force an assembly implementation
+(Pallas runs in interpret mode off-TPU), ``auto`` picks Pallas on TPU
+and jnp elsewhere.
+
+Outputs follow the conventions the parametric case solver consumes
+(parallel/case_solve.py): A(w)/B(w) are [nw, 6, 6] about the platform
+reference point, and the excitation X(w, heading) is referenced to the
+global origin (incident-wave phase evaluated at the panels' *global*
+positions), so ``X * zeta`` adds coherently to the strip-theory
+Froude-Krylov terms with no per-case phase offset.
+"""
+
+from __future__ import annotations
+
+import logging
+
+import numpy as np
+import jax
+import jax.numpy as jnp
+
+from ..config import bem_mode
+from ..ops import bessel
+from .greens import green_table, lookup3
+from .potential_bem import SELF_TERM_COEF
+
+_LOG = logging.getLogger(__name__)
+
+# panel-count bucket granularity: TPU lane width, shared by the jnp and
+# Pallas assembly paths so both see identical padded shapes
+_BUCKET = 128
+# row-block height of the Pallas assembly kernel (f32 sublane-aligned)
+_ROW_BLOCK = 128
+# frequencies per compiled deep-water step (upper bound; shrunk to fit
+# the memory budget at large N)
+_NW_BLOCK = 8
+# device-bytes budget for one design-block's live matrix set
+_ND_BUDGET = 384 << 20
+
+# compiled-program memo: same bucket shapes on a later sweep reuse the
+# executable, so warm BEM sweeps add zero XLA compiles
+_PROG_CACHE: dict = {}
+
+
+def assembly_choice(mode=None):
+    """Resolve the assembly implementation: ``('jnp'|'pallas', interpret)``.
+
+    Mirrors the smallsolve dispatcher: ``auto`` keeps the Pallas kernel
+    for real TPUs and plain jnp elsewhere (interpret mode is a
+    correctness tool, not a fast path); forcing ``pallas`` off-TPU runs
+    the kernel interpreted so CPU tests exercise the same code path.
+    """
+    mode = mode or bem_mode()
+    if mode not in ("auto", "pallas", "jnp"):
+        raise ValueError(f"BEM assembly mode {mode!r}: expected "
+                         "'auto', 'pallas' or 'jnp'")
+    backend = jax.default_backend()
+    if mode == "auto":
+        return ("pallas", False) if backend == "tpu" else ("jnp", False)
+    if mode == "pallas":
+        return "pallas", backend != "tpu"
+    return "jnp", False
+
+
+# ----------------------------------------------------------------------
+# host side: per-variant meshing and bucketing
+# ----------------------------------------------------------------------
+
+def mesh_variant(topos, geoms, dz=0, da=0):
+    """Host-mesh the potMod members of one design variant.
+
+    PRP-local coordinates (poses at r6 = 0 — member headings are already
+    baked into rA0/rB0), so the influence matrices and rigid-body modes
+    come out about the platform reference point.  Masking and normal
+    orientation replicate ``PanelBEM.__init__`` (no irregular-frequency
+    lid: the sweep path matches ``calcBEM``'s default).
+    """
+    from .mesh import PanelMesh
+    from ..structure.member import axis_length
+
+    mesh = PanelMesh()
+    for topo, geom in zip(topos, geoms):
+        if not topo.pot_mod:
+            continue
+        stations = np.asarray(geom.stations_frac) * float(np.asarray(axis_length(geom)))
+        ds = np.asarray(geom.d)
+        if ds.ndim == 2:  # rectangular members: mean side as equivalent diameter
+            ds = ds.mean(axis=1)
+        rA = np.asarray(geom.rA0, dtype=float)
+        rB = np.asarray(geom.rB0, dtype=float)
+        mesh.add_member(stations, ds, rA, rB,
+                        dz_max=dz if dz else 0, da_max=da if da else 0)
+
+    areas, centroids, normals = mesh.areas_centroids_normals()
+    keep = (areas > 1e-8) & (centroids[:, 2] < -1e-6)
+    areas = areas[keep]
+    centroids = centroids[keep]
+    normals = normals[keep]
+    if np.sum(centroids[:, 2] * normals[:, 2] * areas) < 0:
+        normals = -normals
+    return areas, centroids, normals
+
+
+def _bucket_size(n):
+    return max(_BUCKET, int(np.ceil(n / _BUCKET)) * _BUCKET)
+
+
+def _stack_bucket(panels, Nmax):
+    """Stack per-design (areas, centroids, normals) into padded arrays.
+
+    Padded panels have zero area (every influence coefficient is
+    proportional to the source area, so padded columns are exactly
+    zero), centroid (0, 0, -1) (strictly below the free surface, so the
+    image distance never vanishes) and normal (0, 0, 1).
+    """
+    nd = len(panels)
+    A = np.zeros((nd, Nmax))
+    C = np.zeros((nd, Nmax, 3))
+    C[:, :, 2] = -1.0
+    Nrm = np.zeros((nd, Nmax, 3))
+    Nrm[:, :, 2] = 1.0
+    msk = np.zeros((nd, Nmax))
+    for i, (a, c, n) in enumerate(panels):
+        m = len(a)
+        A[i, :m] = a
+        C[i, :m] = c
+        Nrm[i, :m] = n
+        msk[i, :m] = 1.0
+    # rigid-body mode normal velocities about the PRP, masked so padded
+    # panels never enter the boundary conditions or force integrals
+    modes = np.zeros((nd, 6, Nmax))
+    modes[:, 0:3, :] = np.swapaxes(Nrm, 1, 2) * msk[:, None, :]
+    modes[:, 3:6, :] = np.swapaxes(np.cross(C, Nrm), 1, 2) * msk[:, None, :]
+    return A, C, Nrm, msk, modes
+
+
+# ----------------------------------------------------------------------
+# frequency-independent assembly: Rankine + free-surface image
+# ----------------------------------------------------------------------
+
+def _rankine_jnp_single(C, A, Nrm):
+    """jnp mirror of ``potential_bem._rankine_matrices`` for one padded
+    design [N]: identical desingularized arithmetic, plus a +1 guard on
+    the *padded* columns only (A == 0 gives eps == 0, and the pad-pad
+    diagonal would otherwise divide 0 by 0; real-panel values are
+    untouched because their guard term is exactly zero)."""
+    Ci = C[:, None, :]
+    Cj = C[None, :, :]
+    Cj_im = Cj * jnp.array([1.0, 1.0, -1.0], dtype=C.dtype)
+
+    d = Ci - Cj
+    d1 = Ci - Cj_im
+    pad = jnp.where(A[None, :] > 0.0, 0.0, 1.0)
+    eps = A[None, :] / SELF_TERM_COEF**2
+    r2 = jnp.sum(d * d, axis=-1)
+    r1sq = jnp.sum(d1 * d1, axis=-1)
+    den = r2 + eps + pad
+    den1 = r1sq + eps + pad
+
+    S0 = A[None, :] / jnp.sqrt(den) + A[None, :] / jnp.sqrt(den1)
+
+    n = A.shape[0]
+    offdiag = 1.0 - jnp.eye(n, dtype=C.dtype)
+    # flat-panel PV value on the diagonal; the -2*pi jump is added in
+    # the boundary-condition rows (same convention as PanelBEM.solve)
+    G_direct = -d / den[..., None] ** 1.5 * A[None, :, None] * offdiag[..., None]
+    G_image = -d1 / den1[..., None] ** 1.5 * A[None, :, None]
+    D0 = jnp.einsum("ijk,ik->ij", G_direct + G_image, Nrm)
+    return S0, D0
+
+
+def _bottom_image_single(C, A, Nrm, h):
+    """Finite-depth bottom-image Rankine term (one padded design), the
+    jnp mirror of the ``S_bot``/``D_bot`` block in ``PanelBEM.__init__``
+    (John kernel only; no diagonal zeroing — the image point is never
+    the collocation point for wetted panels)."""
+    Cim = C * jnp.array([1.0, 1.0, -1.0], dtype=C.dtype) \
+        + jnp.array([0.0, 0.0, -2.0 * h], dtype=C.dtype)
+    d2 = C[:, None, :] - Cim[None, :, :]
+    pad = jnp.where(A[None, :] > 0.0, 0.0, 1.0)
+    eps = A[None, :] / SELF_TERM_COEF**2
+    r2sq = jnp.sum(d2 * d2, axis=-1)
+    den = r2sq + eps + pad
+    S_b = A[None, :] / jnp.sqrt(den)
+    G_b = -d2 / den[..., None] ** 1.5 * A[None, :, None]
+    D_b = jnp.einsum("ijk,ik->ij", G_b, Nrm)
+    return S_b, D_b
+
+
+def _rankine_kernel(xr, yr, zr, nxr, nyr, nzr, xc, yc, zc, ac, s0_ref, d0_ref):
+    """Pallas row-block: S0/D0 for rows [i*BR, (i+1)*BR) of one design.
+
+    Row operands arrive as [BR, 1] blocks and column operands as [1, N]
+    blocks, so every product is a rank-1 broadcast on the VPU — no
+    transposes or gathers inside the kernel.  Index bookkeeping uses
+    2D ``broadcasted_iota`` (1D iota does not lower on TPU)."""
+    import jax.lax as lax
+
+    xi, yi, zi = xr[0], yr[0], zr[0]          # [BR, 1]
+    nx, ny, nz = nxr[0], nyr[0], nzr[0]
+    xj, yj, zj, aj = xc[0], yc[0], zc[0], ac[0]  # [1, N]
+
+    dx = xi - xj
+    dy = yi - yj
+    dz = zi - zj
+    dz1 = zi + zj  # free-surface image: source mirrored about z = 0
+
+    pad = jnp.where(aj > 0.0, 0.0, 1.0)
+    eps = aj / SELF_TERM_COEF**2
+    den = dx * dx + dy * dy + dz * dz + eps + pad
+    den1 = dx * dx + dy * dy + dz1 * dz1 + eps + pad
+
+    s0_ref[0] = aj / jnp.sqrt(den) + aj / jnp.sqrt(den1)
+
+    br, n = den.shape
+    row = lax.broadcasted_iota(jnp.int32, (br, n), 0) + pl_program_id(1) * br
+    col = lax.broadcasted_iota(jnp.int32, (br, n), 1)
+    offdiag = jnp.where(row == col, 0.0, 1.0).astype(den.dtype)
+
+    g_dir = -(dx * nx + dy * ny + dz * nz) / den ** 1.5 * aj * offdiag
+    g_img = -(dx * nx + dy * ny + dz1 * nz) / den1 ** 1.5 * aj
+    d0_ref[0] = g_dir + g_img
+
+
+def pl_program_id(axis):
+    from jax.experimental import pallas as pl
+
+    return pl.program_id(axis)
+
+
+def _rankine_pallas(C, A, Nrm, interpret):
+    """Pallas assembly over a whole bucket: grid (designs, row blocks)."""
+    from jax.experimental import pallas as pl
+
+    nd, N, _ = C.shape
+    br = min(_ROW_BLOCK, N)
+    rowv = lambda x: x[..., None]   # [nd, N, 1]
+    colv = lambda x: x[:, None, :]  # [nd, 1, N]
+    ins = [
+        rowv(C[..., 0]), rowv(C[..., 1]), rowv(C[..., 2]),
+        rowv(Nrm[..., 0]), rowv(Nrm[..., 1]), rowv(Nrm[..., 2]),
+        colv(C[..., 0]), colv(C[..., 1]), colv(C[..., 2]), colv(A),
+    ]
+    row_spec = pl.BlockSpec((1, br, 1), lambda d, i: (d, i, 0))
+    col_spec = pl.BlockSpec((1, 1, N), lambda d, i: (d, 0, 0))
+    out_spec = pl.BlockSpec((1, br, N), lambda d, i: (d, i, 0))
+    fn = pl.pallas_call(
+        _rankine_kernel,
+        grid=(nd, N // br),
+        in_specs=[row_spec] * 6 + [col_spec] * 4,
+        out_specs=[out_spec, out_spec],
+        out_shape=[jax.ShapeDtypeStruct((nd, N, N), C.dtype)] * 2,
+        interpret=interpret,
+    )
+    return fn(*ins)
+
+
+def rankine_matrices_batch(C, A, Nrm, mode=None):
+    """Batched frequency-independent S0/D0 ([nd, N, N]) with the
+    jnp/pallas dispatch.  Compiled programs are memoized by shape so
+    repeated sweeps at the same bucket geometry never recompile."""
+    impl, interpret = assembly_choice(mode)
+    C = jnp.asarray(C)
+    A = jnp.asarray(A)
+    Nrm = jnp.asarray(Nrm)
+    key = ("rankine", impl, interpret, C.shape, str(C.dtype))
+    prog = _PROG_CACHE.get(key)
+    if prog is None:
+        if impl == "pallas":
+            fn = lambda c, a, n: _rankine_pallas(c, a, n, interpret)
+        else:
+            fn = jax.vmap(_rankine_jnp_single)
+        lowered = jax.jit(fn).lower(C, A, Nrm)
+        prog = lowered.compile()
+        _PROG_CACHE[key] = prog
+        _observe(key, lowered, prog)
+    return prog(C, A, Nrm)
+
+
+def _observe(key, lowered, compiled):
+    """Feed one built BEM program to the observability seams.
+
+    Cost model: a ``program_cost`` ledger event (shape-hashed key, so
+    distinct bucket shapes stay distinct in the roofline report).
+    graftaudit: when armed, the IR audit under the STABLE name
+    ``bem:<stage>:<impl>`` — the name the graftaudit.toml ratchet
+    entries key on (the batched assembly/solve is shard-local, so the
+    no-collectives default applies to it like the primal sweep
+    programs).
+    """
+    from ..analysis import costmodel
+
+    tag = ":".join(str(p) for p in key[:2])
+    costmodel.observe_program(f"bem:{tag}:{hash(key) & 0xffffff:06x}",
+                              "bem", lowered, compiled)
+    import sys as _sys
+
+    ga = _sys.modules.get("raft_tpu.analysis.graftaudit")
+    if ga is None:
+        from ..config import audit_config
+        if audit_config()["enabled"]:
+            from ..analysis import graftaudit as ga
+    if ga is not None and ga.armed():
+        # stage (+impl for the dispatched assembly); shape params stay
+        # out of the name so the toml entries match every bucket
+        stable = (f"bem:{key[0]}:{key[1]}" if key[0] == "rankine"
+                  else f"bem:{key[0]}")
+        ga.observe_program(stable, "bem", lowered, compiled)
+
+
+# ----------------------------------------------------------------------
+# per-frequency solve (deep-water blocks + finite-depth per frequency)
+# ----------------------------------------------------------------------
+
+def _radiate_excite(wi, ki, S, D, modes, A, msk, C, Nrm, heads, xy_off,
+                    prof, dprof, rho, g):
+    """Shared radiation + Haskind stage for one (design, frequency).
+
+    Identical math to ``PanelBEM.solve``'s ``radiate_and_excite`` with
+    two batched-tier differences: padded rows become identity rows in
+    the LHS (exactly decoupled — real rows carry exactly-zero
+    coefficients on padded columns), and the incident-wave phase is
+    evaluated at the panels' global positions (PRP-local + xy_off), so
+    the excitation needs no downstream phase offset."""
+    N = A.shape[0]
+    cdtype = jnp.complex128 if S.real.dtype == jnp.float64 else jnp.complex64
+    eye = jnp.eye(N, dtype=cdtype)
+    lhs = D.astype(cdtype) - 2.0 * jnp.pi * eye
+    rowmask = msk[:, None].astype(S.real.dtype)
+    lhs = rowmask * lhs + (1.0 - rowmask) * eye
+    rhs = modes.T.astype(cdtype)  # [N, 6]; padded entries already zero
+    sigma = jnp.linalg.solve(lhs, rhs)
+    phi = S.astype(cdtype) @ sigma  # [N, 6] potential per unit normal velocity
+    Fr = -1j * wi * rho * jnp.einsum("mn,nj,n->mj", modes, phi, A)
+
+    x_g = C[:, 0] + xy_off[0]
+    y_g = C[:, 1] + xy_off[1]
+
+    def incident(bh):
+        kx = ki * (x_g * jnp.cos(bh) + y_g * jnp.sin(bh))
+        phase = jnp.exp(-1j * kx)
+        phi0 = (g / wi) * prof * phase
+        grad = jnp.stack([
+            -1j * ki * jnp.cos(bh) * phi0,
+            -1j * ki * jnp.sin(bh) * phi0,
+            (g / wi) * dprof * phase,
+        ], axis=-1)
+        dphi0_dn = jnp.einsum("ni,ni->n", grad, Nrm)
+        Xm = -1j * wi * rho * (
+            jnp.einsum("mn,n,n->m", modes, phi0, A)
+            - jnp.einsum("nm,n,n->m", phi, dphi0_dn, A)
+        )
+        return Xm
+
+    X = jax.vmap(incident)(heads)
+    return Fr.real, Fr.imag, X.real, X.imag
+
+
+def _deep_geometry(C, A):
+    dxy = C[:, None, :2] - C[None, :, :2]
+    Rh = jnp.linalg.norm(dxy, axis=-1)
+    zz = C[:, None, 2] + C[None, :, 2]
+    e_xy = dxy / (Rh[..., None] + 1e-9)
+    a_floor = 0.38 * jnp.sqrt(A)
+    return Rh, zz, e_xy, a_floor
+
+
+def _wave_matrices_deep(ki, Rh, zz, e_xy, a_floor, A, Nrm, tabs):
+    """jnp mirror of ``PanelBEM._wave_matrices`` (tables traced)."""
+    Aw = ki * jnp.maximum(Rh, a_floor[None, :])
+    V = ki * zz
+    I0, dIdA, dIdV = lookup3(tabs, Aw, V)
+    j0A = bessel.j0(Aw)
+    j1A = bessel.j1(Aw)
+    expV = jnp.exp(jnp.clip(V, -200.0, 0.0))
+    Gw = 2.0 * ki * I0 + 2j * jnp.pi * ki * expV * j0A
+    dG_dA = 2.0 * ki * dIdA - 2j * jnp.pi * ki * expV * j1A
+    dG_dV = 2.0 * ki * dIdV + 2j * jnp.pi * ki * expV * j0A
+    gx = dG_dA * ki * e_xy[..., 0]
+    gy = dG_dA * ki * e_xy[..., 1]
+    gz = dG_dV * ki
+    S_w = Gw * A[None, :]
+    D_w = (gx * Nrm[:, 0:1] + gy * Nrm[:, 1:2] + gz * Nrm[:, 2:3]) * A[None, :]
+    return S_w, D_w
+
+
+def _deep_block(C, A, Nrm, modes, msk, S0, D0, wv, kv, heads, tabs, xy_off,
+                rho, g):
+    """One design-block x one ω-block, deep-water kernel.  vmapped over
+    designs (outer) and frequencies (inner); the batched complex solve
+    lands on the MXU as [nd*nwb, N, N]."""
+
+    def per_design(C1, A1, N1, m1, k1, S01, D01):
+        Rh, zz, e_xy, a_floor = _deep_geometry(C1, A1)
+
+        def per_freq(wi, ki):
+            S_w, D_w = _wave_matrices_deep(ki, Rh, zz, e_xy, a_floor, A1, N1, tabs)
+            prof = jnp.exp(ki * C1[:, 2])
+            dprof = ki * prof
+            return _radiate_excite(wi, ki, S01 + S_w, D01 + D_w, m1, A1, k1,
+                                   C1, N1, heads, xy_off, prof, dprof, rho, g)
+
+        return jax.vmap(per_freq)(wv, kv)
+
+    return jax.vmap(per_design, in_axes=(0, 0, 0, 0, 0, 0, 0))(
+        C, A, Nrm, modes, msk, S0, D0)
+
+
+def _fd_block(C, A, Nrm, modes, msk, S0b, D0b, wi, ki, tabs6, res_ch, res_sh,
+              heads, xy_off, rho, g, h, R_max):
+    """One design-block x one frequency, finite-depth John kernel.
+    ``S0b/D0b`` already include the bottom-image Rankine term; the
+    tables (6-tuple) and residue profiles are traced so one program
+    serves every finite-depth frequency of the bucket."""
+    from .greens_fd import lookup_f1, lookup_f2
+
+    def per_design(C1, A1, N1, m1, k1, S01, D01, rc1, rs1):
+        dxy = C1[:, None, :2] - C1[None, :, :2]
+        Rh = jnp.linalg.norm(dxy, axis=-1)
+        e_xy = dxy / (Rh[..., None] + 1e-9)
+        a_floor = 0.38 * jnp.sqrt(A1)
+        R = jnp.maximum(Rh, a_floor[None, :])
+        u = C1[:, None, 2] + C1[None, :, 2]
+        w_d = C1[:, None, 2] - C1[None, :, 2]
+
+        F1, dF1_dR, dF1_du = lookup_f1(tabs6, R_max, h, R, u)
+        F2, dF2_dR, dF2_dw = lookup_f2(tabs6, R_max, h, R, w_d)
+
+        res = rc1[:, None] * rc1[None, :]
+        dres_dz = ki * rs1[:, None] * rc1[None, :]
+
+        kR = ki * R
+        j0A = bessel.j0(kR)
+        j1A = bessel.j1(kR)
+
+        Gw = F1 + F2 + 1j * jnp.pi * res * j0A
+        dG_dR = dF1_dR + dF2_dR - 1j * jnp.pi * res * ki * j1A
+        dG_dz = dF1_du + jnp.sign(w_d) * dF2_dw + 1j * jnp.pi * dres_dz * j0A
+
+        gx = dG_dR * e_xy[..., 0]
+        gy = dG_dR * e_xy[..., 1]
+        S_w = Gw * A1[None, :]
+        D_w = (gx * N1[:, 0:1] + gy * N1[:, 1:2] + dG_dz * N1[:, 2:3]) \
+            * A1[None, :]
+
+        # overflow-safe finite-depth incident profile (PanelBEM.solve)
+        z = C1[:, 2]
+        den_p = 1.0 + jnp.exp(-2.0 * ki * h)
+        ekz = jnp.exp(ki * z)
+        prof = ekz * (1.0 + jnp.exp(-2.0 * ki * (z + h))) / den_p
+        dprof = ki * ekz * (1.0 - jnp.exp(-2.0 * ki * (z + h))) / den_p
+
+        return _radiate_excite(wi, ki, S01 + S_w, D01 + D_w, m1, A1, k1,
+                               C1, N1, heads, xy_off, prof, dprof, rho, g)
+
+    return jax.vmap(per_design, in_axes=(0, 0, 0, 0, 0, 0, 0, 0, 0))(
+        C, A, Nrm, modes, msk, S0b, D0b, res_ch, res_sh)
+
+
+def _block_sizes(N, nd, itemsize=16):
+    """(nd_block, nw_block) fitting the live matrix set in _ND_BUDGET."""
+    per_freq = 6 * N * N * itemsize
+    nwb = int(max(1, min(_NW_BLOCK, _ND_BUDGET // max(per_freq, 1))))
+    ndb = int(max(1, min(8, _ND_BUDGET // max(nwb * per_freq, 1))))
+    return min(ndb, nd), nwb
+
+
+def _compiled(key, fn, args):
+    prog = _PROG_CACHE.get(key)
+    if prog is None:
+        lowered = jax.jit(fn).lower(*args)
+        prog = lowered.compile()
+        _PROG_CACHE[key] = prog
+        _observe(key, lowered, prog)
+    return prog(*args)
+
+
+# ----------------------------------------------------------------------
+# top level
+# ----------------------------------------------------------------------
+
+def solve_design_batch(fowt, treedef, stacked, n_designs, w, k,
+                       headings_deg=(0.0,), dz=0, da=0, mode=None):
+    """Batched first-order BEM over a stacked design batch.
+
+    Parameters mirror the sweep's resident state: ``treedef``/``stacked``
+    are the variant pytree from ``stack_variants`` (leaves [nv, ...]),
+    ``w``/``k`` the case frequency grid, ``headings_deg`` the union of
+    case wave headings.  Returns per-design parameter leaves for the
+    parametric case solver::
+
+        Abem [nd, nw, 6, 6]   added mass about the PRP
+        Bbem [nd, nw, 6, 6]   radiation damping
+        Xbre/Xbim [nd, nbh, 6, nw]  excitation per unit amplitude,
+                              global-origin phase reference
+        bem_h [nd, nbh]       solved headings (radians, sorted)
+    """
+    topos = [cm.topo for cm in fowt.memberList]
+    depth = getattr(fowt, "depth", None)
+    depth = None if (depth is None or not np.isfinite(depth)) else float(depth)
+    rho = float(fowt.rho_water)
+    g = float(fowt.g)
+    xy_off = np.array([float(fowt.x_ref), float(fowt.y_ref)])
+
+    host_leaves = [np.asarray(leaf) for leaf in stacked]
+    panels = []
+    for i in range(n_designs):
+        geoms, _moor = jax.tree_util.tree_unflatten(
+            treedef, [leaf[i] for leaf in host_leaves])
+        panels.append(mesh_variant(topos, geoms, dz=dz, da=da))
+
+    return solve_panel_batch(panels, w, k, headings_deg, depth=depth,
+                             rho=rho, g=g, xy_off=xy_off, mode=mode)
+
+
+def solve_panel_batch(panels, w, k, headings_deg=(0.0,), depth=None,
+                      rho=1025.0, g=9.81, xy_off=(0.0, 0.0), mode=None):
+    """Batched BEM over explicit panel sets (the post-meshing half of
+    :func:`solve_design_batch`; also the test seam for parity checks).
+
+    ``panels`` is a list of (areas [N_i], centroids [N_i, 3],
+    normals [N_i, 3]) per design, already masked and oriented.
+    """
+    w_np = np.asarray(w, dtype=float)
+    k_np = np.asarray(k, dtype=float)
+    nw = len(w_np)
+    heads_deg = np.unique(np.asarray(headings_deg, dtype=float) % 360.0)
+    heads = np.radians(heads_deg)
+    nbh = len(heads)
+    n_designs = len(panels)
+    rho = float(rho)
+    g = float(g)
+    xy_off = np.asarray(xy_off, dtype=float)
+
+    counts = np.array([len(p[0]) for p in panels])
+    if np.any(counts == 0):
+        bad = int(np.argmax(counts == 0))
+        raise ValueError(f"design {bad}: potMod members meshed to zero "
+                         "wetted panels")
+    _LOG.info("bem_batch: %d designs, %d freqs, %d headings, panels %d-%d",
+              n_designs, nw, nbh, counts.min(), counts.max())
+
+    A_out = np.zeros((n_designs, nw, 6, 6))
+    B_out = np.zeros((n_designs, nw, 6, 6))
+    X_out = np.zeros((n_designs, nbh, 6, nw), dtype=complex)
+
+    tabs_deep = green_table().jtables()
+    jheads = jnp.asarray(heads)
+    jxy = jnp.asarray(xy_off)
+
+    # bucket designs by padded panel count
+    buckets: dict[int, list[int]] = {}
+    for i, c in enumerate(counts):
+        buckets.setdefault(_bucket_size(int(c)), []).append(i)
+
+    # deep/finite-depth frequency partition (same rule as PanelBEM.solve)
+    if depth is not None:
+        fd_idx = [i for i in range(nw) if k_np[i] * depth < 6.0]
+    else:
+        fd_idx = []
+    deep_idx = [i for i in range(nw) if i not in set(fd_idx)]
+
+    for Nmax, members in sorted(buckets.items()):
+        A_h, C_h, N_h, m_h, modes_h = _stack_bucket(
+            [panels[i] for i in members], Nmax)
+        ndb, nwb = _block_sizes(Nmax, len(members))
+
+        fd_tables = None
+        if fd_idx:
+            from .greens_fd import GreenTableFD, build_tables_batch
+
+            # one table set per bucket: John tables depend on (K, h, R_max)
+            # only, so the bucket-global max horizontal separation (over
+            # real panels — pads sit at the origin and must not widen the
+            # grid) lets every design in the bucket share them
+            R_max = float(max(
+                np.max(np.linalg.norm(
+                    panels[i][1][:, None, :2] - panels[i][1][None, :, :2],
+                    axis=-1))
+                for i in members))
+            Ks = [w_np[i] ** 2 / g for i in fd_idx]
+            # same table-build rule as PanelBEM.solve: K-blocked batch
+            # quadrature for long accelerator runs, per-K scalar builds
+            # on CPU / short grids (the two quadratures agree to ~1e-3;
+            # matching the rule keeps single-design parity exact)
+            if len(Ks) > 8 and jax.default_backend() != "cpu":
+                fd_tables = build_tables_batch(Ks, depth, R_max)
+            else:
+                fd_tables = {K: GreenTableFD(K, depth, R_max) for K in Ks}
+
+        for lo in range(0, len(members), ndb):
+            sel = members[lo:lo + ndb]
+            take = list(range(lo, lo + len(sel)))
+            # pad the last design block by repeating its first design
+            take = take + [take[0]] * (ndb - len(take))
+            jC = jnp.asarray(C_h[take])
+            jA = jnp.asarray(A_h[take])
+            jN = jnp.asarray(N_h[take])
+            jm = jnp.asarray(m_h[take])
+            jmodes = jnp.asarray(modes_h[take])
+
+            S0, D0 = rankine_matrices_batch(jC, jA, jN, mode=mode)
+
+            # deep-water frequencies in ω-blocks
+            for wlo in range(0, len(deep_idx), nwb):
+                blk = deep_idx[wlo:wlo + nwb]
+                # pad the last ω-block by repeating its last frequency
+                pad_blk = blk + [blk[-1]] * (nwb - len(blk))
+                wv = jnp.asarray(w_np[pad_blk])
+                kv = jnp.asarray(k_np[pad_blk])
+                key = ("deep", Nmax, ndb, nwb, nbh, str(jC.dtype), rho, g)
+                FrR, FrI, XR, XI = _compiled(
+                    key,
+                    lambda C_, A_, N_, M_, K_, S_, D_, wv_, kv_, h_, t_, xy_:
+                        _deep_block(C_, A_, N_, M_, K_, S_, D_, wv_, kv_,
+                                    h_, t_, xy_, rho, g),
+                    (jC, jA, jN, jmodes, jm, S0, D0, wv, kv, jheads,
+                     tabs_deep, jxy))
+                _scatter(A_out, B_out, X_out, sel, blk, w_np,
+                         np.asarray(FrR), np.asarray(FrI),
+                         np.asarray(XR), np.asarray(XI))
+
+            # finite-depth frequencies one at a time (per-K John tables)
+            if fd_idx:
+                from .greens_fd import residue_coef
+
+                h = depth
+                Sb, Db = _compiled(
+                    ("botimg", Nmax, ndb, str(jC.dtype), h),
+                    lambda C_, A_, N_: jax.vmap(
+                        lambda c, a, n: _bottom_image_single(c, a, n, h)
+                    )(C_, A_, N_),
+                    (jC, jA, jN))
+                S0b = S0 + Sb
+                D0b = D0 + Db
+                for i in fd_idx:
+                    tab = fd_tables[w_np[i] ** 2 / g]
+                    rc = residue_coef(tab.K, h, tab.k)
+                    arg = np.minimum(tab.k * (C_h[take][:, :, 2] + h), 300.0)
+                    res_ch = jnp.asarray(np.sqrt(rc) * np.cosh(arg))
+                    res_sh = jnp.asarray(np.sqrt(rc) * np.sinh(arg))
+                    key = ("fd", Nmax, ndb, nbh, str(jC.dtype), rho, g, h,
+                           round(tab.R_max, 6))
+                    FrR, FrI, XR, XI = _compiled(
+                        key,
+                        lambda C_, A_, N_, M_, K_, S_, D_, wi_, ki_, t6_,
+                               rc_, rs_, h_, xy_:
+                            _fd_block(C_, A_, N_, M_, K_, S_, D_, wi_, ki_,
+                                      t6_, rc_, rs_, h_, xy_, rho, g, h,
+                                      tab.R_max),
+                        (jC, jA, jN, jmodes, jm, S0b, D0b,
+                         jnp.asarray(w_np[i]), jnp.asarray(k_np[i]),
+                         tab.jarrays(), res_ch, res_sh, jheads, jxy))
+                    _scatter(A_out, B_out, X_out, sel, [i], w_np,
+                             np.asarray(FrR)[:, None], np.asarray(FrI)[:, None],
+                             np.asarray(XR)[:, None], np.asarray(XI)[:, None])
+
+    return {
+        "Abem": A_out,
+        "Bbem": B_out,
+        "Xbre": np.ascontiguousarray(X_out.real),
+        "Xbim": np.ascontiguousarray(X_out.imag),
+        "bem_h": np.tile(heads, (n_designs, 1)),
+    }
+
+
+def _scatter(A_out, B_out, X_out, sel, blk, w_np, FrR, FrI, XR, XI):
+    """Write one block's results ([ndb, nwb, ...], possibly padded)
+    into the per-design output arrays (padding discarded)."""
+    for di, d in enumerate(sel):
+        for wi_local, i in enumerate(blk):
+            A_out[d, i] = FrI[di, wi_local] / w_np[i]
+            B_out[d, i] = -FrR[di, wi_local]
+            X_out[d, :, :, i] = XR[di, wi_local] + 1j * XI[di, wi_local]

@@ -171,6 +171,39 @@ class GreenTable:
     def pv_dV(self, A, V):
         return self._lookup(self._jdV, A, V)
 
+    def jtables(self):
+        """Device tables as a tuple of traced-arg arrays (I0, dI/dA, dI/dV)
+        for callers that jit over the tables instead of closing over them
+        (hydro/bem_batch.py)."""
+        return (self._jI0, self._jdA, self._jdV)
+
+
+def lookup3(tables, A, V):
+    """Bilinear (I0, dI/dA, dI/dV) lookups sharing one index computation.
+
+    ``tables`` is the 3-tuple from :meth:`GreenTable.jtables`, passed as
+    traced arguments so the batched-assembly jits (hydro/bem_batch.py)
+    don't bake the ~4 MB tables into every compiled program.  Per-table
+    arithmetic matches :meth:`GreenTable._lookup` exactly.
+    """
+    jI0, jdA, jdV = tables
+    ia = jnp.sqrt(jnp.clip(A, 0.0, _A_MAX) / _A_MAX) * (_NA - 1)
+    iv = jnp.sqrt(jnp.clip(V, _V_MIN, 0.0) / _V_MIN) * (_NV - 1)
+    i0 = jnp.clip(jnp.floor(ia).astype(jnp.int32), 0, _NA - 2)
+    j0_ = jnp.clip(jnp.floor(iv).astype(jnp.int32), 0, _NV - 2)
+    ta = ia - i0
+    tv = iv - j0_
+
+    def take(table):
+        v00 = table[i0, j0_]
+        v10 = table[i0 + 1, j0_]
+        v01 = table[i0, j0_ + 1]
+        v11 = table[i0 + 1, j0_ + 1]
+        return ((1 - ta) * (1 - tv) * v00 + ta * (1 - tv) * v10
+                + (1 - ta) * tv * v01 + ta * tv * v11)
+
+    return take(jI0), take(jdA), take(jdV)
+
 
 _table_cache: dict[int, GreenTable] = {}
 

@@ -146,19 +146,58 @@ class PanelMesh:
     def add_panel(self, verts):
         """Add one panel (4x3), clipping to z<=0 and deduping nodes;
         collapses to a triangle if two clipped vertices coincide."""
-        verts = np.array(verts, dtype=float)
-        if (verts[:, 2] > 0).all():
-            return
-        verts[:, 2] = np.minimum(verts[:, 2], 0.0)
+        self.add_panels(np.asarray(verts, dtype=float)[None, :, :])
 
-        ids = []
-        for p in verts:
-            nid = self._node_id(p)
-            if nid not in ids:
-                ids.append(nid)
-        if len(ids) < 3:
+    def add_panels(self, verts):
+        """Bulk panel insertion ([P,4,3]), identical semantics (and node/
+        panel ordering) to calling :meth:`add_panel` per row, but with
+        array-based node dedup: ``np.unique`` over the quantized vertex
+        set replaces the per-vertex dict lookup, so meshing 1000 design
+        variants is O(vertices log vertices) numpy work instead of a
+        Python loop per panel."""
+        verts = np.array(verts, dtype=float)
+        if verts.size == 0:
             return
-        self.panels.append([len(self.panels) + 1, len(ids)] + ids)
+        keep = ~(verts[:, :, 2] > 0).all(axis=1)
+        verts = verts[keep]
+        if not len(verts):
+            return
+        verts[:, :, 2] = np.minimum(verts[:, :, 2], 0.0)
+
+        flat = verts.reshape(-1, 3)
+        quant = np.round(flat, 6)
+        uq, first_idx, inv = np.unique(quant, axis=0, return_index=True,
+                                       return_inverse=True)
+        keys = [(float(r[0]), float(r[1]), float(r[2])) for r in uq]
+        ids_of_unique = np.empty(len(uq), dtype=np.int64)
+        new_rows = []
+        for i, k in enumerate(keys):
+            nid = self._node_index.get(k)
+            if nid is None:
+                new_rows.append(i)
+            else:
+                ids_of_unique[i] = nid
+        # new nodes take ids in first-occurrence order of the flattened
+        # vertex stream — exactly the order the sequential path assigns
+        new_rows.sort(key=lambda i: first_idx[i])
+        for i in new_rows:
+            p = flat[first_idx[i]]
+            self.nodes.append([float(p[0]), float(p[1]), float(p[2])])
+            nid = len(self.nodes)
+            self._node_index[keys[i]] = nid
+            ids_of_unique[i] = nid
+
+        pan_ids = ids_of_unique[inv.reshape(-1)].reshape(-1, 4)
+        # within-panel order-preserving dedup: vertex j is a duplicate if
+        # it equals any earlier vertex of the same panel
+        eq = pan_ids[:, :, None] == pan_ids[:, None, :]
+        dup = (eq & np.tril(np.ones((4, 4), dtype=bool), -1)[None]).any(axis=2)
+        counts = 4 - dup.sum(axis=1)
+        for row, d, cnt in zip(pan_ids.tolist(), dup.tolist(), counts.tolist()):
+            if cnt < 3:
+                continue
+            ids = [v for v, is_dup in zip(row, d) if not is_dup]
+            self.panels.append([len(self.panels) + 1, cnt] + ids)
 
     def add_member(self, stations, diameters, rA, rB, dz_max=0, da_max=0):
         """Mesh one axisymmetric member (meshMember equivalent)."""
@@ -184,31 +223,37 @@ class PanelMesh:
                       [c2 * s1, c1, s1 * s2],
                       [-s2, 0.0, c2]])
 
-        for quad in quads:
-            self.add_panel(quad @ R.T + rA[None, :])
+        if quads:
+            self.add_panels(np.stack(quads) @ R.T + rA[None, None, :])
         return self
 
     def areas_centroids_normals(self):
-        """Panel areas, centroids, and outward normals (for the BEM solver)."""
-        A, C, N = [], [], []
+        """Panel areas, centroids, and outward normals (for the BEM solver).
+
+        Vectorized over the panel set (triangles padded by repeating the
+        last vertex; the per-type formulas match the scalar originals
+        exactly, including the triangle's mean-of-3 centroid)."""
+        if not self.panels:
+            return (np.zeros(0), np.zeros((0, 3)), np.zeros((0, 3)))
         nodes = np.asarray(self.nodes)
-        for p in self.panels:
-            v = nodes[np.array(p[2:]) - 1]
-            if p[1] == 3:
-                a = 0.5 * np.linalg.norm(np.cross(v[1] - v[0], v[2] - v[0]))
-                c = v.mean(axis=0)
-                n = np.cross(v[1] - v[0], v[2] - v[0])
-            else:
-                d1 = v[2] - v[0]
-                d2 = v[3] - v[1]
-                n = 0.5 * np.cross(d1, d2)
-                a = np.linalg.norm(n)
-                c = v.mean(axis=0)
-            nn = np.linalg.norm(n)
-            N.append(n / nn if nn > 0 else np.array([0.0, 0.0, 1.0]))
-            A.append(a)
-            C.append(c)
-        return np.array(A), np.array(C), np.array(N)
+        nv = np.array([p[1] for p in self.panels])
+        idx = np.array([p[2:] + [p[1 + p[1]]] * (4 - p[1])
+                        for p in self.panels]) - 1
+        v = nodes[idx]  # [P,4,3]
+        tri = nv == 3
+
+        n_quad = 0.5 * np.cross(v[:, 2] - v[:, 0], v[:, 3] - v[:, 1])
+        a_quad = np.linalg.norm(n_quad, axis=1)
+        n_tri = np.cross(v[:, 1] - v[:, 0], v[:, 2] - v[:, 0])
+        a_tri = 0.5 * np.linalg.norm(n_tri, axis=1)
+
+        n = np.where(tri[:, None], n_tri, n_quad)
+        a = np.where(tri, a_tri, a_quad)
+        c = np.where(tri[:, None], v[:, :3].mean(axis=1), v.mean(axis=1))
+        nn = np.linalg.norm(n, axis=1)
+        N = np.where(nn[:, None] > 0, n / np.where(nn[:, None] > 0, nn[:, None], 1.0),
+                     np.array([0.0, 0.0, 1.0]))
+        return a, c, N
 
     # ------------------------------------------------------------------
     # writers

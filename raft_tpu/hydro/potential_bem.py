@@ -227,11 +227,19 @@ class PanelBEM:
                 Ks.append(K)
         if not Ks:
             return
+        # the cap is a hard ceiling, prebuild included: a grid longer
+        # than the cache only prebuilds its first _FD_CACHE_MAX
+        # frequencies (solve() walks the grid in order, so these are
+        # consumed before the lazily built tail evicts them), and every
+        # insert evicts FIFO first — a long finite-depth ω-grid can
+        # never park more than the cap's worth of device tables.
+        cap = self._FD_CACHE_MAX
+        Ks = Ks[:cap]
         R_max = float(np.max(np.asarray(self.Rh)))
         tabs = build_tables_batch(Ks, self.depth, R_max)
-        self._FD_CACHE_MAX = max(self._FD_CACHE_MAX,
-                                 len(tabs) + len(self._fd_tables) + 8)
         for K, tab in tabs.items():
+            while len(self._fd_tables) >= cap:
+                self._fd_tables.pop(next(iter(self._fd_tables)))
             self._fd_tables[round(float(K), 10)] = tab
 
     def _orient_normals(self):
@@ -441,10 +449,9 @@ class PanelBEM:
                 X_out[:, :, i] = np.asarray(XR) + 1j * np.asarray(XI)
 
         finally:
-            # release prebuilt Green tables beyond the steady-state cap so
-            # a big grid doesn't leave hundreds of MB of device arrays
-            # parked on an idle solver object, even when a solve fails
-            self._FD_CACHE_MAX = PanelBEM._FD_CACHE_MAX
+            # belt and braces: prebuild_fd_tables enforces the cap on
+            # every insert, so this only trims if a subclass or direct
+            # _fd_tables mutation overfilled the cache mid-solve
             while len(self._fd_tables) > self._FD_CACHE_MAX:
                 self._fd_tables.pop(next(iter(self._fd_tables)))
 

@@ -88,6 +88,13 @@ EVENTS: dict[str, tuple] = {
     "preempt": ("signal",),                     # graceful-shutdown drain;
                                                 #   + done, n_designs,
                                                 #   checkpoint
+    # -- potential-flow BEM tier (raft_tpu.hydro.bem_batch) ---------------
+    "bem_precompute": ("cache", "designs"),     # batched radiation/
+                                                #   diffraction solve per
+                                                #   (design batch, heading
+                                                #   set); cache: 'hit' |
+                                                #   'miss'; + nw, headings,
+                                                #   seconds
     # -- flight recorder (raft_tpu.obs.flightrec) -------------------------
     "convergence_summary": ("chunk", "n_iter", "iters", "final_resid"),
                                                 # per-chunk worst-over-cases

@@ -204,6 +204,13 @@ def make_parametric_solver(static, n_iter=15, with_health=False,
         if aero is not None:
             M_const = M_const + aero["A"]
             B_const = B_const + aero["B"]
+        # potential-flow BEM coefficients (hydro/bem_batch.py): presence-
+        # gated exactly like aero so the BEM-off trace stays bit-identical
+        # to the seed solver.  A(ω)/B(ω) are [nw,6,6] and fold into the
+        # broadcast the [1,6,6] strip-theory M/B already use.
+        if "Abem" in params:
+            M_const = M_const + params["Abem"]
+            B_const = B_const + params["Bbem"]
 
         r_nodes = nodes["r"]  # [N,3]
         offs = r_nodes - prp
@@ -243,6 +250,28 @@ def make_parametric_solver(static, n_iter=15, with_health=False,
             TI = jnp.concatenate([nodes["imat"], skew @ nodes["imat"]], axis=1)
             Fexc = (jnp.einsum("nsj,hnjw->hsw", TI, ud)
                     + jnp.einsum("ns,hnw->hsw", Pa, pDyn))
+
+        if "Xbre" in params:
+            # BEM wave excitation per unit amplitude at the sweep's solved
+            # headings params["bem_h"] (sorted, radians).  Cases sample it
+            # by linear interpolation over heading — exact whenever the
+            # case heading is one of the solved headings, which the sweep
+            # precompute guarantees by solving the union of case headings.
+            # The excitation phase is referenced to the global origin,
+            # matching wave_kinematics' zeta convention, so X·zeta adds
+            # coherently to the strip-theory Froude–Krylov terms above.
+            Xb = params["Xbre"] + 1j * params["Xbim"]  # [nbh,6,nw]
+            bh = params["bem_h"]
+            nbh = Xb.shape[0]
+            if nbh == 1:
+                Xh = jnp.broadcast_to(Xb[0][None], (nH,) + Xb.shape[1:])
+            else:
+                i1 = jnp.clip(jnp.searchsorted(bh, beta), 1, nbh - 1)
+                i0 = i1 - 1
+                t = jnp.clip((beta - bh[i0])
+                             / jnp.maximum(bh[i1] - bh[i0], 1e-12), 0.0, 1.0)
+                Xh = (1.0 - t)[:, None, None] * Xb[i0] + t[:, None, None] * Xb[i1]
+            Fexc = Fexc + Xh * zeta[:, None, :]
 
         def impedance(B_drag):
             return (

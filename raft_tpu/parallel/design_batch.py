@@ -370,6 +370,44 @@ def _rna_mass_about_prp(Mdiag, R_q, r_CG_rel):
     return transforms.translate_matrix_6to6(Mmat, r_CG_rel)
 
 
+def check_batch_capability(fowt):
+    """Raise :class:`SweepAxisError` when ``fowt``'s hydro configuration
+    is outside the batched compiler's scope.
+
+    Shared between :func:`make_batch_compiler` (cold template build) and
+    the sweep's template-memo hit path (sweep.py): the verdict depends on
+    the RAFT_TPU_BEM mode read at call time, so a memoized compiler must
+    re-check instead of trusting the answer baked in when it was built —
+    otherwise flipping the knob between sweeps of the same design would
+    silently change which physics runs.
+    """
+    if fowt.potSecOrder:
+        raise SweepAxisError("second-order potential flow (potSecOrder) is "
+                             "not supported in the batched design compiler")
+    if any(cm.topo.pot_mod for cm in fowt.memberList) \
+            or getattr(fowt, "potFirstOrder", 0):
+        # first-order potential flow is handled by the batched BEM tier
+        # (hydro/bem_batch.py): the sweep precomputes A/B/X per design and
+        # threads them into the parametric solver, while this compiler
+        # zeroes the pot members' strip-theory inertial terms exactly like
+        # flatten_members does.  With the tier off, refuse like the
+        # pre-tier compiler so the sweep takes the per-variant fallback.
+        from ..config import bem_mode
+        if bem_mode() == "off":
+            raise SweepAxisError(
+                "potential-flow members need the batched BEM tier, which is "
+                "disabled (RAFT_TPU_BEM=off) - strip-theory only")
+        if getattr(fowt, "potFirstOrder", 0):
+            raise SweepAxisError(
+                "potFirstOrder (precomputed WAMIT coefficients) is not "
+                "expressible as a batched-geometry axis; use potModMaster 2 "
+                "so the BEM tier can solve the swept geometry natively")
+    for rot in fowt.rotorList:
+        if rot.r3[2] + getattr(rot, "R_rot", 0.0) < 0:
+            raise SweepAxisError("underwater rotors are not supported in the "
+                                 "batched design compiler")
+
+
 def make_batch_compiler(fowt):
     """Build ``compile_one(geoms, moor_params) -> params`` for vmapping
     over stacked design variants.
@@ -383,13 +421,7 @@ def make_batch_compiler(fowt):
     is closed over from the template.
     """
     topos = [cm.topo for cm in fowt.memberList]
-    if any(t.pot_mod for t in topos) or getattr(fowt, "potFirstOrder", 0) or fowt.potSecOrder:
-        raise SweepAxisError("batched design compiler supports strip-theory "
-                             "(potModMaster 1) configurations only")
-    for rot in fowt.rotorList:
-        if rot.r3[2] + getattr(rot, "R_rot", 0.0) < 0:
-            raise SweepAxisError("underwater rotors are not supported in the "
-                                 "batched design compiler")
+    check_batch_capability(fowt)
 
     # order-preserving grouping by identical topology (name/type/shape are
     # part of the topology, so member role is uniform within a group)
@@ -463,7 +495,12 @@ def make_batch_compiler(fowt):
                 lambda ge, po: mstruct.member_hydro_constants(
                     topo, ge, po, r_ref=prp, rho=rho, g=g, k_array=k_arr)
             )(geo, poses)
-            A_hydro = A_hydro + jnp.sum(hydro["A_hydro"], axis=0)
+            # potential-flow members take added mass/excitation from the
+            # BEM tier; zero their strip-theory inertial terms exactly
+            # like flatten_members (drag and hydrostatics are kept)
+            pot = bool(topo.pot_mod)
+            if not pot:
+                A_hydro = A_hydro + jnp.sum(hydro["A_hydro"], axis=0)
 
             c = jax.vmap(mstruct.node_coefficients)(geo, poses)
             va = jax.vmap(lambda po: mstruct.node_volumes_areas(topo, po))(poses)
@@ -481,8 +518,11 @@ def make_batch_compiler(fowt):
                 im = jnp.broadcast_to(hydro["Imat"][..., None], hydro["Imat"].shape + (nw,))
             else:
                 im = hydro["Imat"]
+            if pot:
+                im = jnp.zeros_like(im)
             node_parts["imat"].append(flat(im))
-            node_parts["a_i"].append(flat(hydro["a_i"]))
+            node_parts["a_i"].append(
+                flat(jnp.zeros_like(hydro["a_i"]) if pot else hydro["a_i"]))
             for key in ("Cd_q", "Cd_p1", "Cd_p2", "Cd_end"):
                 node_parts[key].append(flat(c[key]))
             for src, dst in (("a_drag_q", "a_drag_q"), ("a_drag_p1", "a_drag_p1"),

@@ -42,9 +42,10 @@ from .obs import ledger as obs_ledger
 from .obs import log as obs_log
 from .obs.trace import maybe_trace
 from .ops import waves
-from .parallel.design_batch import (SweepAxisError, pack_rows, pack_spec,
-                                    set_in_design, stack_variants,
-                                    unpack_leaves, variant_finite_mask)
+from .parallel.design_batch import (SweepAxisError, check_batch_capability,
+                                    pack_rows, pack_spec, set_in_design,
+                                    stack_variants, unpack_leaves,
+                                    variant_finite_mask)
 from .parallel.compile_service import CompileService
 from .parallel.executor import (CheckpointWriter, FaultIsolator,
                                 chunk_selector, start_host_fetch,
@@ -694,6 +695,12 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
     aero_axes = []
     try:
         if memo is not None:
+            # the capability verdict depends on RAFT_TPU_BEM *now*, not
+            # when the memoized compiler was built — re-check so a knob
+            # flip between sweeps routes to the fallback (with its
+            # capability_fallback event) instead of silently reusing a
+            # compiler whose physics assumptions no longer hold
+            check_batch_capability(fowt)
             compile_one, static = memo["compile_one"], memo["static"]
         else:
             compile_one, static = make_batch_compiler(fowt)
@@ -745,12 +752,17 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 "without `wind`, or via the full Model per point.") from e
         # the fallback is a capability DOWNGRADE, not just a slow path:
         # its per-variant solve never runs calcBEM (core/fowt.py:353 —
-        # A_BEM/B_BEM stay zero) and has no F_BEM/QTF term, so
-        # potential-flow designs lose their BEM added mass/damping and
-        # second-order forces.  Record the degradation in the ledger
-        # (capability_fallback -> raft_capability_fallbacks_total) and,
-        # when forces are actually being dropped, warn loudly
-        # (-> raft_warnings_total) instead of proceeding silently.
+        # A_BEM/B_BEM stay zero) and has no F_BEM/QTF term.  First-order
+        # potential flow normally never gets here anymore — the batched
+        # BEM tier (hydro/bem_batch.py) solves potMod members /
+        # potModMaster 2-3 natively on the batched path — so landing in
+        # this handler with a potential-flow design means the tier was
+        # unavailable (RAFT_TPU_BEM=off, potFirstOrder file coefficients,
+        # potSecOrder) or a non-batchable axis forced the downgrade.
+        # Record the degradation in the ledger (capability_fallback ->
+        # raft_capability_fallbacks_total) and, when forces are actually
+        # being dropped, warn loudly (-> raft_warnings_total) instead of
+        # proceeding silently.
         dropped = []
         if any(cm.topo.pot_mod for cm in fowt.memberList) \
                 or fowt.potModMaster in (2, 3) \
@@ -766,8 +778,11 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 "sweep: per-variant fallback path DROPS "
                 + " and ".join(dropped)
                 + f" for this potential-flow design ({e}); results omit "
-                "those contributions — use the full Model.analyzeCases "
-                "path for potential-flow configurations",
+                "those contributions — keep the sweep on the batched "
+                "path (RAFT_TPU_BEM=auto solves first-order BEM "
+                "natively there) or use the full Model.analyzeCases "
+                "path for configurations the tier cannot express "
+                "(potFirstOrder/potSecOrder)",
                 RuntimeWarning, stacklevel=3)
         if display:
             obs_log.display(_LOG, f"sweep: falling back to per-variant model path ({e})")
@@ -778,6 +793,27 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         spec = pack_spec(stacked)
         n_leaves = len(stacked)
         zetas, betas = _sea_state_waves(fowt, sea_states)
+
+        # ---- batched potential-flow BEM tier (hydro/bem_batch.py) ----
+        # Potential-flow configurations reach this batched path only when
+        # the tier is available (parallel/design_batch.py raises
+        # SweepAxisError otherwise), so `bem_active` here means "the
+        # precompute below MUST run and its A/B/X leaves ride into every
+        # chunk's params".  The solved heading set is the union of the
+        # case headings, so the per-case interpolation in case_solve is
+        # exact for every case.  Headings are expected in [0, 360):
+        # within that range radians(h % 360) == radians(h) bit-exactly,
+        # so bem_h entries equal the case betas and the interpolation
+        # degenerates to a gather.
+        from .config import bem_mode
+        bem_active = (bem_mode() != "off"
+                      and (any(cm.topo.pot_mod for cm in fowt.memberList)
+                           or fowt.potModMaster in (2, 3)))
+        bem_heads = None
+        if bem_active:
+            bem_heads = tuple(sorted({
+                float(ss[2]) % 360.0 if len(ss) > 2 else 0.0
+                for ss in sea_states}))
 
         # turbine (aero) axes: designs gather their turbine variant from
         # per-variant tables (RNA mass properties, aero-servo impedance,
@@ -833,6 +869,12 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             # every trace-off memo/exec-cache key byte-identical to the
             # seed's — the zero-extra-compiles contract.
             health_sig = health_sig + (True,)
+        if bem_active:
+            # the BEM leaves extend partB's params signature (shapes
+            # depend on the solved heading count); extending the key ONLY
+            # when the tier is active keeps every BEM-off memo/exec-cache
+            # key byte-identical to the seed's.
+            health_sig = health_sig + (("bem", len(bem_heads)),)
         jit_key = (mode, place_sig, chunk_size, n_cases, len(av_combos),
                    health_sig)
         ecfg = executor_config()
@@ -1118,6 +1160,19 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
             params_sds = jax.tree_util.tree_map(
                 lambda o: jax.ShapeDtypeStruct(o.shape, o.dtype),
                 lA.out_info[1])
+            if bem_active:
+                # the precomputed BEM leaves join partA's params at
+                # dispatch (fresh per-chunk host slices, so partB's
+                # donation stays safe); partB/case_solve presence-gate on
+                # the keys, so lowering B against the extended dict is
+                # what compiles the BEM consumption in
+                nbh = len(bem_heads)
+                params_sds = dict(params_sds)
+                params_sds["Abem"] = sds((chunk_size, nw, 6, 6), fdt)
+                params_sds["Bbem"] = sds((chunk_size, nw, 6, 6), fdt)
+                params_sds["Xbre"] = sds((chunk_size, nbh, 6, nw), fdt)
+                params_sds["Xbim"] = sds((chunk_size, nbh, 6, nw), fdt)
+                params_sds["bem_h"] = sds((chunk_size, nbh), fdt)
             nrot = max(1, len(fowt.rotorList))
             if mode == "plain":
                 argsB = (params_sds, zetas, betas)
@@ -1247,6 +1302,50 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 sel_variants["A"] = np.stack(A_l)
                 sel_variants["B"] = np.stack(B_l)
             sel_variants = put_r(sel_variants)
+
+        # batched BEM precompute: ONE radiation/diffraction solve per
+        # (design batch, ω grid, heading set) — overlapped with the
+        # background chunk compiles above, like the aero tables.  The
+        # result is host numpy [n_designs, ...] leaves sliced per chunk
+        # at dispatch (the resident executor keeps the packed GEOMETRY
+        # on device; the BEM leaves are small — 2·nw·36 + 2·nbh·6·nw
+        # floats per design — so per-chunk H2D is noise).  Memoized in
+        # the template memo next to the stack, keyed by the stacked
+        # batch identity plus the solved heading set, so warm repeat
+        # sweeps skip the solve entirely.
+        bem_host = None
+        if bem_active and not compile_only:
+            bem_key = ((stack_key, bem_heads)
+                       if stack_key is not None else None)
+            entry = _TEMPLATE_MEMO.get(memo_key)
+            bcache = None
+            if (bem_key is not None and entry is not None
+                    and entry.get("treedef") == treedef
+                    and entry.get("spec") == spec):
+                bcache = entry.setdefault("bem", {})
+                bem_host = bcache.get(bem_key)
+            if bem_host is None:
+                from .hydro.bem_batch import solve_design_batch
+                bdt = np.dtype(zetas.dtype)
+                with profiling.phase("sweep/bem"):
+                    t0 = time.perf_counter()
+                    bem_host = solve_design_batch(
+                        fowt, treedef, stacked, n_designs,
+                        np.asarray(fowt.w), np.asarray(fowt.k),
+                        headings_deg=bem_heads)
+                    bem_host = {k: np.ascontiguousarray(v, dtype=bdt)
+                                for k, v in bem_host.items()}
+                run.emit("bem_precompute", cache="miss",
+                         designs=n_designs, nw=int(static["nw"]),
+                         headings=len(bem_heads),
+                         seconds=round(time.perf_counter() - t0, 6))
+                if bcache is not None:
+                    while len(bcache) >= 2:
+                        bcache.pop(next(iter(bcache)))
+                    bcache[bem_key] = bem_host
+            else:
+                run.emit("bem_precompute", cache="hit",
+                         designs=n_designs, headings=len(bem_heads))
 
         if compile_only:
             # precompile(): join, memoize (and, with RAFT_TPU_EXEC_CACHE,
@@ -1484,21 +1583,35 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                         # device_put commits exactly the executables'
                         # design sharding, so no new XLA programs
                         packed = [put_d(b) for b in pack_rows(stacked, spec, idx)]
+                def _with_bem(params):
+                    # thread the precomputed BEM leaves into partB's
+                    # params: fresh per-chunk host slices through put_d,
+                    # so B's argnum-0 donation never aliases a buffer
+                    # that is read again (quarantine re-executions slice
+                    # again, so they are covered identically)
+                    if bem_host is None:
+                        return params
+                    params = dict(params)
+                    rows = np.asarray(idx)
+                    for kb in ("Abem", "Bbem", "Xbre", "Xbim", "bem_h"):
+                        params[kb] = put_d(bem_host[kb][rows])
+                    return params
+
                 with profiling.phase("compute"):
                     if mode == "plain":
                         pr, params = cA(packed)
-                        outB = cB(params, zetas, betas)
+                        outB = cB(_with_bem(params), zetas, betas)
                     elif mode == "aero":
                         pr, params = cA(packed)
-                        outB = cB(params, zetas, betas, aero)
+                        outB = cB(_with_bem(params), zetas, betas, aero)
                     else:
                         av_dev = put_d(aero_idx[idx])
                         pr, params = cA(packed, sel_variants["rna"], av_dev)
                         if mode == "sel":
-                            outB = cB(params, zetas, betas,
+                            outB = cB(_with_bem(params), zetas, betas,
                                       sel_variants["zh"], av_dev)
                         else:
-                            outB = cB(params, zetas, betas,
+                            outB = cB(_with_bem(params), zetas, betas,
                                       {k: sel_variants[k] for k in ("A", "B", "zh")},
                                       av_dev)
                 tr = None

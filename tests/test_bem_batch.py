@@ -1,0 +1,357 @@
+"""Batched on-device BEM tier tests (raft_tpu.hydro.bem_batch).
+
+Validation strategy:
+
+1.  Assembly parity — the Pallas Rankine kernel (interpret mode on CPU)
+    against the pure-jnp reference assembly, elementwise.
+2.  Solver parity — a single design through ``solve_panel_batch`` must
+    reproduce ``PanelBEM.solve`` (the per-design solver validated in
+    tests/test_bem.py against energy identities, RefPanelBEM and the
+    native C++ kernels) to machine precision, deep water AND finite
+    depth.
+3.  Padding exactness — bucketed N_max padding must contribute EXACT
+    zeros (padded columns) and identity rows, so real-panel results are
+    bit-identical at a fixed program shape; across DIFFERENT bucket
+    shapes results agree to reduction-order tolerance only, which is
+    also pinned here.
+4.  Sweep integration — potMod configurations run the batched path end
+    to end (no SweepAxisError fallback, no dropped-coefficient
+    warnings), BEM-off routes to the per-variant fallback with the
+    capability warning, and BEM-off sweeps compile zero extra XLA
+    programs (the seed-trace contract).
+"""
+
+import os
+import warnings
+
+import numpy as np
+import pytest
+
+from raft_tpu.hydro import bem_batch
+from raft_tpu.hydro.bem_batch import (rankine_matrices_batch,
+                                      solve_panel_batch)
+from raft_tpu.hydro.mesh import PanelMesh
+from raft_tpu.hydro.potential_bem import PanelBEM
+
+RHO = 1000.0
+G = 9.81
+
+
+def hemi_mesh(npts=18, dz=0.22, da=0.5, R=1.0):
+    zs = np.linspace(-R, 0, npts)
+    ds = 2.0 * np.sqrt(np.maximum(R**2 - zs**2, 0.0))
+    mesh = PanelMesh()
+    mesh.add_member(zs - zs[0], ds, rA=np.array([0.0, 0.0, zs[0]]),
+                    rB=np.array([0.0, 0.0, 0.0]), dz_max=dz, da_max=da)
+    return mesh
+
+
+def panels_of(bem):
+    """(areas, centroids, normals) as solve_panel_batch consumes them —
+    PanelBEM has already applied the identical mask/orientation rules."""
+    return (np.asarray(bem.areas), np.asarray(bem.centroids),
+            np.asarray(bem.normals))
+
+
+@pytest.fixture(scope="module")
+def hemi_bem():
+    return PanelBEM(hemi_mesh(), rho=RHO, g=G)
+
+
+# ---------------------------------------------------------------------------
+# assembly parity: pallas (interpret on CPU) vs jnp
+# ---------------------------------------------------------------------------
+
+
+def test_rankine_pallas_vs_jnp(hemi_bem):
+    import jax.numpy as jnp
+
+    pan = panels_of(hemi_bem)
+    Nmax = bem_batch._bucket_size(len(pan[0]))
+    A, C, Nrm, msk, modes = bem_batch._stack_bucket([pan, pan], Nmax)
+    S_j, D_j = rankine_matrices_batch(C, A, Nrm, mode="jnp")
+    S_p, D_p = rankine_matrices_batch(C, A, Nrm, mode="pallas")
+    np.testing.assert_allclose(np.asarray(S_p), np.asarray(S_j),
+                               rtol=1e-12, atol=1e-13)
+    np.testing.assert_allclose(np.asarray(D_p), np.asarray(D_j),
+                               rtol=1e-12, atol=1e-13)
+    # both designs in the stack are the same panels: rows must agree
+    np.testing.assert_array_equal(np.asarray(S_j[0]), np.asarray(S_j[1]))
+
+
+def test_assembly_choice_modes(monkeypatch):
+    impl, interp = bem_batch.assembly_choice("jnp")
+    assert (impl, interp) == ("jnp", False)
+    impl, interp = bem_batch.assembly_choice("pallas")
+    assert impl == "pallas"
+    import jax
+    assert interp == (jax.default_backend() != "tpu")
+    impl, _ = bem_batch.assembly_choice("auto")
+    assert impl == ("pallas" if jax.default_backend() == "tpu" else "jnp")
+    with pytest.raises(ValueError):
+        bem_batch.assembly_choice("nope")
+
+
+# ---------------------------------------------------------------------------
+# solver parity: batched tier vs PanelBEM.solve
+# ---------------------------------------------------------------------------
+
+
+def test_single_design_matches_panelbem_deep(hemi_bem):
+    ka = np.array([0.2, 1.0, 2.5])
+    w = np.sqrt(G * ka)
+    A_ref, B_ref, X_ref = hemi_bem.solve(w, ka, headings_deg=[0.0, 45.0])
+    out = solve_panel_batch([panels_of(hemi_bem)], w, ka,
+                            headings_deg=[0.0, 45.0], rho=RHO, g=G)
+    # PanelBEM layout: A [6,6,nw], X [nh,6,nw]; tier: [nd,nw,6,6]/[nd,nbh,6,nw]
+    np.testing.assert_allclose(out["Abem"][0], np.moveaxis(A_ref, 2, 0),
+                               rtol=1e-10, atol=1e-10 * abs(A_ref).max())
+    np.testing.assert_allclose(out["Bbem"][0], np.moveaxis(B_ref, 2, 0),
+                               rtol=1e-10, atol=1e-10 * abs(B_ref).max())
+    Xb = out["Xbre"][0] + 1j * out["Xbim"][0]
+    np.testing.assert_allclose(Xb, X_ref,
+                               rtol=1e-10, atol=1e-10 * abs(X_ref).max())
+    np.testing.assert_allclose(out["bem_h"][0], np.radians([0.0, 45.0]))
+
+
+def test_single_design_matches_panelbem_finite_depth():
+    from raft_tpu.hydro.greens_fd import wavenumber
+
+    h = 2.0
+    bem = PanelBEM(hemi_mesh(), rho=RHO, g=G, depth=h)
+    Ks = np.array([0.2, 1.0])
+    ks = np.array([wavenumber(K, h) for K in Ks])
+    ws = np.sqrt(G * Ks)
+    assert np.all(ks * h < 6.0)  # the John branch actually runs
+    A_ref, B_ref, X_ref = bem.solve(ws, ks, headings_deg=[0.0])
+    out = solve_panel_batch([panels_of(bem)], ws, ks, headings_deg=[0.0],
+                            depth=h, rho=RHO, g=G)
+    np.testing.assert_allclose(out["Abem"][0], np.moveaxis(A_ref, 2, 0),
+                               rtol=1e-9, atol=1e-9 * abs(A_ref).max())
+    np.testing.assert_allclose(out["Bbem"][0], np.moveaxis(B_ref, 2, 0),
+                               rtol=1e-9, atol=1e-9 * abs(B_ref).max())
+    Xb = out["Xbre"][0] + 1j * out["Xbim"][0]
+    np.testing.assert_allclose(Xb, X_ref,
+                               rtol=1e-9, atol=1e-9 * abs(X_ref).max())
+
+
+def test_multi_design_rows_independent(hemi_bem):
+    """Each design's rows in a batch equal its own single-design solve
+    (same bucket -> same compiled shape -> bit-identical)."""
+    small = PanelBEM(hemi_mesh(npts=12, dz=0.3, da=0.8), rho=RHO, g=G)
+    ka = np.array([0.8])
+    w = np.sqrt(G * ka)
+    both = solve_panel_batch([panels_of(hemi_bem), panels_of(small)],
+                             w, ka, rho=RHO, g=G)
+    for i, b in enumerate((hemi_bem, small)):
+        alone = solve_panel_batch([panels_of(b)], w, ka, rho=RHO, g=G)
+        np.testing.assert_array_equal(both["Abem"][i], alone["Abem"][0])
+        np.testing.assert_array_equal(both["Bbem"][i], alone["Bbem"][0])
+        np.testing.assert_array_equal(both["Xbre"][i], alone["Xbre"][0])
+
+
+# ---------------------------------------------------------------------------
+# padding exactness
+# ---------------------------------------------------------------------------
+
+
+def test_padded_columns_exact_zero(hemi_bem):
+    pan = panels_of(hemi_bem)
+    n = len(pan[0])
+    Nmax = n + 37  # arbitrary padding (buckets round to 128 multiples;
+    # the exactness property must hold for ANY pad amount)
+    A, C, Nrm, msk, modes = bem_batch._stack_bucket([pan], Nmax)
+    S, D = rankine_matrices_batch(C, A, Nrm, mode="jnp")
+    S, D = np.asarray(S), np.asarray(D)
+    # padded panels have zero area -> their columns are EXACT zeros
+    assert np.all(S[:, :, n:] == 0.0)
+    assert np.all(D[:, :, n:] == 0.0)
+    # real-panel block matches the unpadded assembly bit-for-bit
+    A1, C1, Nrm1, _, _ = bem_batch._stack_bucket([pan], n)
+    S1, D1 = rankine_matrices_batch(C1, A1, Nrm1, mode="jnp")
+    np.testing.assert_array_equal(S[:, :n, :n], np.asarray(S1))
+    np.testing.assert_array_equal(D[:, :n, :n], np.asarray(D1))
+    # padded modes columns are masked off
+    assert np.all(np.asarray(modes)[:, :, n:] == 0.0)
+
+
+def test_cross_bucket_shape_tolerance(hemi_bem, monkeypatch):
+    """Results across DIFFERENT padded program shapes agree to
+    reduction-order tolerance (exact bit-identity holds only at a fixed
+    shape; analytically-zero couplings see ~1e-17-relative noise)."""
+    ka = np.array([0.8])
+    w = np.sqrt(G * ka)
+    out_a = solve_panel_batch([panels_of(hemi_bem)], w, ka, rho=RHO, g=G)
+    monkeypatch.setattr(bem_batch, "_BUCKET", 512)
+    out_b = solve_panel_batch([panels_of(hemi_bem)], w, ka, rho=RHO, g=G)
+    for key in ("Abem", "Bbem", "Xbre", "Xbim"):
+        scale = np.abs(out_a[key]).max()
+        np.testing.assert_allclose(out_b[key], out_a[key],
+                                   rtol=1e-9, atol=1e-12 * scale)
+
+
+def test_zero_panel_design_raises():
+    with pytest.raises(ValueError, match="zero wetted panels"):
+        solve_panel_batch(
+            [(np.zeros(0), np.zeros((0, 3)), np.zeros((0, 3)))],
+            np.array([1.0]), np.array([0.1]))
+
+
+# ---------------------------------------------------------------------------
+# fd table-cache regression (satellite: unbounded _fd_table growth)
+# ---------------------------------------------------------------------------
+
+
+@pytest.mark.slow
+def test_fd_table_cache_capped(monkeypatch):
+    from raft_tpu.hydro.greens_fd import wavenumber
+
+    h = 2.0
+    bem = PanelBEM(hemi_mesh(npts=10, dz=0.4, da=1.0), rho=RHO, g=G, depth=h)
+    monkeypatch.setattr(PanelBEM, "_FD_CACHE_MAX", 3)
+    Ks = np.linspace(0.1, 0.6, 7)
+    assert all(wavenumber(K, h) * h < 6.0 for K in Ks)
+    for K in Ks:
+        bem._fd_table(K)
+    assert len(bem._fd_tables) <= 3
+    bem._fd_tables.clear()
+    bem.prebuild_fd_tables(np.sqrt(G * Ks))
+    assert len(bem._fd_tables) <= 3
+
+
+# ---------------------------------------------------------------------------
+# calcBEM parity through the design-batch entry point
+# ---------------------------------------------------------------------------
+
+
+def _pot_design():
+    from raft_tpu.designs import demo_spar
+
+    d = demo_spar(nw_freqs=(0.05, 0.4))
+    d["platform"]["potModMaster"] = 0
+    d["platform"]["members"][0]["potMod"] = True
+    return d
+
+
+@pytest.mark.slow
+def test_solve_design_batch_matches_calcbem():
+    """The stacked-variant meshing + batched solve reproduces
+    fowt.calcBEM (same mesh rules, same solver) for the base design."""
+    from raft_tpu.core.model import Model
+    from raft_tpu.parallel.design_batch import stack_variants
+    from raft_tpu.hydro.bem_batch import solve_design_batch
+
+    d = _pot_design()
+    model = Model(d)
+    fowt = model.fowtList[0]
+    fowt.setPosition(np.zeros(6))
+    fowt.calcStatics()
+    fowt.calcBEM()
+
+    axes = [("platform.members.0.d", [d["platform"]["members"][0]["d"]])]
+    stacked, treedef, _ = stack_variants(
+        d, axes, [(d["platform"]["members"][0]["d"],)],
+        rho=fowt.rho_water, g=fowt.g, x_ref=fowt.x_ref, y_ref=fowt.y_ref,
+        heading_adjust=fowt.heading_adjust)
+    out = solve_design_batch(fowt, treedef, stacked, 1,
+                             np.asarray(fowt.w), np.asarray(fowt.k),
+                             headings_deg=(0.0,))
+    A_ref = np.moveaxis(np.asarray(fowt.A_BEM), 2, 0)
+    B_ref = np.moveaxis(np.asarray(fowt.B_BEM), 2, 0)
+    X_ref = np.asarray(fowt.X_BEM)  # [1,6,nw], heading-relative; 0 deg = global
+    sA = max(np.abs(A_ref).max(), 1.0)
+    np.testing.assert_allclose(out["Abem"][0], A_ref, atol=1e-8 * sA)
+    np.testing.assert_allclose(out["Bbem"][0], B_ref, atol=1e-8 * sA)
+    Xb = out["Xbre"][0] + 1j * out["Xbim"][0]
+    np.testing.assert_allclose(Xb, X_ref, atol=1e-8 * np.abs(X_ref).max())
+
+
+# ---------------------------------------------------------------------------
+# sweep integration
+# ---------------------------------------------------------------------------
+
+_AXES = [("platform.members.0.d",
+          [[9.4, 9.4, 6.5, 6.5], [10.0, 10.0, 6.5, 6.5]])]
+_STATES = [(4.0, 8.0), (6.0, 10.0, 30.0)]
+
+
+@pytest.mark.slow
+def test_sweep_potmod_end_to_end(monkeypatch):
+    """potMod designs run the BATCHED path natively: no SweepAxisError
+    fallback, no dropped-coefficient warning, healthy responses that
+    actually carry the BEM physics (differ from the strip-only run)."""
+    monkeypatch.delenv("RAFT_TPU_BEM", raising=False)
+    from raft_tpu import sweep as sweep_mod
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")  # any DROPS warning fails the test
+        out = sweep_mod.sweep(_pot_design(), _AXES, _STATES, n_iter=15)
+    assert np.all(out["status"] == 0)
+    assert np.all(np.isfinite(out["motion_std"]))
+
+    monkeypatch.setenv("RAFT_TPU_BEM", "off")
+    with warnings.catch_warnings(record=True) as rec:
+        warnings.simplefilter("always")
+        out_off = sweep_mod.sweep(_pot_design(), _AXES, _STATES, n_iter=15)
+    assert any("DROPS" in str(w.message) for w in rec)
+    # the fallback run omits the BEM contributions -> different physics
+    assert np.nanmax(np.abs(out["motion_std"] - out_off["motion_std"])) > 1e-6
+
+
+@pytest.mark.slow
+def test_sweep_bem_modes_agree(monkeypatch):
+    """RAFT_TPU_BEM=jnp and =pallas (interpret on CPU) agree through the
+    full sweep to solver tolerance."""
+    from raft_tpu import sweep as sweep_mod
+
+    monkeypatch.setenv("RAFT_TPU_BEM", "jnp")
+    out_j = sweep_mod.sweep(_pot_design(), _AXES, _STATES[:1], n_iter=10)
+    monkeypatch.setenv("RAFT_TPU_BEM", "pallas")
+    out_p = sweep_mod.sweep(_pot_design(), _AXES, _STATES[:1], n_iter=10)
+    np.testing.assert_allclose(out_p["motion_std"], out_j["motion_std"],
+                               rtol=1e-8)
+
+
+@pytest.mark.sentinel
+def test_bem_off_sweep_zero_extra_compiles(monkeypatch):
+    """Strip-theory sweeps with the tier merely AVAILABLE (the default)
+    compile nothing beyond the seed programs and stay bit-identical:
+    the BEM leaves extend the traced programs only when a potential-flow
+    member activates the tier."""
+    from raft_tpu.analysis.recompile import RecompileSentinel
+    from raft_tpu.designs import demo_spar
+    from raft_tpu import sweep as sweep_mod
+
+    monkeypatch.delenv("RAFT_TPU_BEM", raising=False)
+    base = demo_spar(nw_freqs=(0.05, 0.4))  # strip-only (potModMaster 1)
+    warm = sweep_mod.sweep(base, _AXES, _STATES, n_iter=6)
+    with RecompileSentinel() as s:
+        snap = s.snapshot()
+        again = sweep_mod.sweep(base, _AXES, _STATES, n_iter=6)
+        s.assert_no_recompile(snap, "warm BEM-available strip sweep")
+        monkeypatch.setenv("RAFT_TPU_BEM", "off")
+        off = sweep_mod.sweep(base, _AXES, _STATES, n_iter=6)
+        s.assert_no_recompile(snap, "warm BEM-off strip sweep")
+    np.testing.assert_array_equal(warm["motion_std"], again["motion_std"])
+    np.testing.assert_array_equal(warm["motion_std"], off["motion_std"])
+
+
+@pytest.mark.slow
+def test_sweep_bem_warm_memo(monkeypatch):
+    """A repeat potMod sweep reuses the memoized BEM precompute (the
+    template memo grows a 'bem' cache) and returns identical results."""
+    monkeypatch.delenv("RAFT_TPU_BEM", raising=False)
+    from raft_tpu import sweep as sweep_mod
+
+    d = _pot_design()
+    first = sweep_mod.sweep(d, _AXES, _STATES[:1], n_iter=10)
+    memo_key = sweep_mod._template_key(d, 10, False)
+    entry = sweep_mod._TEMPLATE_MEMO.get(memo_key)
+    assert entry is not None and entry.get("bem"), \
+        "BEM precompute was not memoized in the template memo"
+    (bem_cached,) = entry["bem"].values()
+    second = sweep_mod.sweep(d, _AXES, _STATES[:1], n_iter=10)
+    np.testing.assert_array_equal(first["motion_std"], second["motion_std"])
+    # the warm repeat reused the SAME host arrays (no re-solve)
+    (bem_cached2,) = entry["bem"].values()
+    assert bem_cached2 is bem_cached
