@@ -290,10 +290,16 @@ def main():
     target = ({"devices": jax.devices()} if mesh_mode
               else {"device": accel})
 
+    # chunk extent: 250 is the single-chip BASELINE config; the mesh
+    # design axis is sized to ceil(n_designs / chunk), so measuring a
+    # wider mesh means a smaller chunk (RAFT_BENCH_CHUNK=125 puts the
+    # 1000-design workload on all 8 shards of an 8-device mesh)
+    chunk = int(os.environ.get("RAFT_BENCH_CHUNK", "250"))
+
     with jax.default_device(cpu):
         t0 = time.perf_counter()
         out = sweep(design, axes, states, n_iter=15, wind=wind,
-                    chunk_size=250, **target)
+                    chunk_size=chunk, **target)
         dt = time.perf_counter() - t0
         assert np.all(np.isfinite(out["motion_std"])), "sweep produced non-finite metrics"
 
@@ -311,7 +317,7 @@ def main():
         # gate) — any nonzero count here is cache-key churn
         with RecompileSentinel() as sentinel:
             out2 = sweep(design, axes, states, n_iter=15, wind=wind,
-                         chunk_size=250, **target)
+                         chunk_size=chunk, **target)
         dt_warm = time.perf_counter() - t0
         phases = profiling.report()
         chunks_s = phases.get("sweep/chunks", float("nan"))

@@ -535,6 +535,73 @@ def resilience_config(overrides=None) -> dict:
     return cfg
 
 
+# Multi-tenant solve server (raft_tpu.serve): admission control,
+# cross-request coalescing, deadlines, and degradation knobs.  All
+# host-side scheduling — nothing here feeds a traced program, so the
+# coalesced chunks stay bit-identical to direct sweep() calls.
+SERVE_DEFAULTS = {
+    "chunk_size": 64,            # coalesced round chunk extent
+    "max_round_designs": 256,    # design rows packed into one round
+    "max_pending_designs": 1024,  # admission bound -> ServerSaturated/429
+    "max_request_designs": 64,   # largest single request accepted
+    "default_priority": 1,       # lower value schedules first
+    "default_deadline_s": 0.0,   # per-request deadline (0 = none)
+    "deadline_grace_s": 2.0,     # round deadline slack over members
+    "retry_rounds": 1,           # requeues after a failed round
+    "breaker_threshold": 2,      # quarantines before a fingerprint trips
+    "breaker_cooldown_s": 300.0,  # fast-fail window once tripped
+    "drain_path": "",            # pending-request checkpoint on drain
+    "port": 0,                   # HTTP front port (0 = ephemeral)
+    "host": "127.0.0.1",
+}
+
+
+def serve_config(overrides=None) -> dict:
+    """Effective solve-server configuration: defaults, then environment
+    (``RAFT_TPU_SERVE_CHUNK``, ``RAFT_TPU_SERVE_MAX_ROUND``,
+    ``RAFT_TPU_SERVE_MAX_PENDING``, ``RAFT_TPU_SERVE_MAX_REQUEST``,
+    ``RAFT_TPU_SERVE_DEADLINE``, ``RAFT_TPU_SERVE_RETRIES``,
+    ``RAFT_TPU_SERVE_BREAKER``, ``RAFT_TPU_SERVE_BREAKER_COOLDOWN``,
+    ``RAFT_TPU_SERVE_DRAIN``, ``RAFT_TPU_SERVE_PORT``,
+    ``RAFT_TPU_SERVE_HOST``), then explicit ``overrides``."""
+    import os
+
+    cfg = dict(SERVE_DEFAULTS)
+    for key, var, cast in (
+            ("chunk_size", "RAFT_TPU_SERVE_CHUNK", int),
+            ("max_round_designs", "RAFT_TPU_SERVE_MAX_ROUND", int),
+            ("max_pending_designs", "RAFT_TPU_SERVE_MAX_PENDING", int),
+            ("max_request_designs", "RAFT_TPU_SERVE_MAX_REQUEST", int),
+            ("default_priority", "RAFT_TPU_SERVE_PRIORITY", int),
+            ("default_deadline_s", "RAFT_TPU_SERVE_DEADLINE", float),
+            ("deadline_grace_s", "RAFT_TPU_SERVE_DEADLINE_GRACE", float),
+            ("retry_rounds", "RAFT_TPU_SERVE_RETRIES", int),
+            ("breaker_threshold", "RAFT_TPU_SERVE_BREAKER", int),
+            ("breaker_cooldown_s", "RAFT_TPU_SERVE_BREAKER_COOLDOWN", float),
+            ("drain_path", "RAFT_TPU_SERVE_DRAIN", str),
+            ("port", "RAFT_TPU_SERVE_PORT", int),
+            ("host", "RAFT_TPU_SERVE_HOST", str)):
+        env = os.environ.get(var)
+        if env is not None:
+            cfg[key] = cast(env)
+    if overrides:
+        unknown = set(overrides) - set(cfg)
+        if unknown:
+            raise ValueError(f"unknown serve config key(s): {sorted(unknown)}")
+        cfg.update(overrides)
+    for key in ("chunk_size", "max_round_designs", "max_pending_designs",
+                "max_request_designs"):
+        if int(cfg[key]) < 1:
+            raise ValueError(f"serve config {key!r} must be >= 1, "
+                             f"got {cfg[key]!r}")
+    if cfg["max_request_designs"] > cfg["max_round_designs"]:
+        raise ValueError(
+            "serve config max_request_designs must not exceed "
+            f"max_round_designs ({cfg['max_request_designs']} > "
+            f"{cfg['max_round_designs']}): one request must fit one round")
+    return cfg
+
+
 # Solver-path selection for the batched 6x6 impedance solves
 # (raft_tpu.parallel.smallsolve): 'auto' benchmarks the Pallas kernel
 # against the plain-jnp elimination at first use per (n, m, B, backend)

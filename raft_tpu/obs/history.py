@@ -170,7 +170,9 @@ def summarize_bench(obj, path="") -> dict | None:
     detail = obj.get("detail") or {}
     metrics = {"wall_s": obj.get("value")}
     for key in ("cold_s", "repeat_sweep_s", "designs_per_sec_repeat",
-                "designs_per_sec_execution", "repeat_xla_compiles"):
+                "designs_per_sec_execution", "repeat_xla_compiles",
+                "serve_p50_s", "serve_p99_s", "serve_rps",
+                "serve_rounds", "serve_requests"):
         if isinstance(detail.get(key), (int, float)):
             metrics[key] = detail[key]
     if isinstance(detail.get("repeat_xla_compiles"), int):

@@ -184,7 +184,7 @@ class Run:
                 self._fh.write(json.dumps(rec, default=_jsonable) + "\n")
                 self._fh.flush()
         if self._metrics:
-            metrics.observe_event(event, rec)
+            metrics.observe_event(event, rec, run_id=self.run_id)
 
     def elapsed(self) -> float:
         return time.time() - self._t0

@@ -6,19 +6,21 @@ process while it runs:
 
 * ``GET /metrics`` — the registry in Prometheus text exposition format
   (scrape it with a stock Prometheus / curl / promtool).
-* ``GET /status``  — JSON: active run id, lifecycle phase, chunk
-  progress and live ETA (the ledger's own ``chunk_commit`` ETA
-  accounting), per-design status tallies.
+* ``GET /status``  — JSON: every concurrent run (``runs``, one entry
+  per live run — the solve server drives many at once) with lifecycle
+  phase, chunk progress and live ETA (the ledger's own ``chunk_commit``
+  ETA accounting) and per-design status tallies; ``active`` is the most
+  recently started run for single-run consumers.
 * ``GET /runs``    — JSON list of recent finished-run summaries.
 * ``GET /healthz`` — liveness for external supervisors: 200 normally,
-  503 while some chunk is past its watchdog deadline
-  (:func:`raft_tpu.robust.elastic.deadline_exceeded`), so an
-  orchestrator can restart a wedged sweep instead of waiting on it.
+  503 while ANY active run has a chunk past its watchdog deadline
+  (:func:`raft_tpu.robust.elastic.deadline_exceeded`, aggregated over
+  concurrent runs; the offending run ids are in ``overdue_runs``), so
+  an orchestrator can restart a wedged process instead of waiting on
+  it.
 
-This is deliberately the embryo of ``raft_tpu/serve/`` (ROADMAP item
-1): it exercises the "report on a sweep from another thread while the
-sweep owns the devices" seam that cross-request coalescing needs,
-without yet accepting work over the wire.
+The solve server (:mod:`raft_tpu.serve`) extends this pattern with a
+request-accepting front (:class:`raft_tpu.serve.http.ServeFront`).
 
 Security: the server is unauthenticated and reports process internals,
 so it binds loopback (``127.0.0.1``) unless ``RAFT_TPU_METRICS_HOST``
@@ -68,10 +70,11 @@ class _Handler(BaseHTTPRequestHandler):
                 # robust layer at module-load time (ledger -> live)
                 from ..robust import elastic
 
-                overdue = elastic.deadline_exceeded()
+                overdue = elastic.overdue_runs()
                 self._send(503 if overdue else 200,
                            json.dumps({"ok": not overdue,
-                                       "watchdog_overdue": overdue}),
+                                       "watchdog_overdue": bool(overdue),
+                                       "overdue_runs": overdue}),
                            "application/json")
             elif path == "/":
                 self._send(200, json.dumps(

@@ -443,7 +443,28 @@ class _Std:
             "Sweeps drained by a stop signal", ("signal",))
         self.watchdog_overdue = g(
             "raft_watchdog_overdue",
-            "1 while some chunk is past its watchdog deadline")
+            "Number of active runs with a chunk past its watchdog "
+            "deadline (0 = healthy)")
+        # solve server (raft_tpu.serve): request lifecycle + coalescing
+        self.requests_total = c(
+            "raft_requests_total",
+            "Solve-server requests by terminal outcome", ("outcome",))
+        self.request_latency = h(
+            "raft_request_latency_seconds",
+            "Solve-server request latency, accept -> delivery",
+            _STAGE_BUCKETS)
+        self.requests_in_flight = g(
+            "raft_requests_in_flight",
+            "Requests admitted and not yet delivered/failed")
+        self.serve_rounds = c(
+            "raft_serve_rounds_total",
+            "Coalesced dispatch rounds run by the solve server")
+        self.coalesced_designs = c(
+            "raft_serve_coalesced_designs_total",
+            "Design rows dispatched through coalesced rounds")
+        self.breaker_trips = c(
+            "raft_breaker_trips_total",
+            "Circuit-breaker trips (design fingerprint fast-failed)")
         # perf observatory (raft_tpu.analysis.costmodel + obs.perf):
         # per-program compile-time statics + per-chunk achieved rates
         self.program_flops = g(
@@ -511,27 +532,45 @@ def render_prometheus() -> str:
 # ---------------------------------------------------------------------------
 
 _STATE_LOCK = threading.Lock()
-_ACTIVE: dict | None = None
+# live per-run state keyed by run_id, insertion-ordered (oldest first):
+# the solve server drives many concurrent runs in one process, so the
+# single-active-run model no longer holds
+_ACTIVE: dict = {}
 _RECENT: deque = deque(maxlen=32)
 _OBSERVE_ERRORS = 0
 
 
+def _resolve_state(run_id):
+    """Per-run live state for ``run_id`` (caller holds ``_STATE_LOCK``).
+
+    ``None`` (an emitter predating run-id forwarding) falls back to the
+    most recently started run, the exact pre-multi-run behaviour when
+    only one run is live."""
+    if run_id is not None:
+        return _ACTIVE.get(run_id)
+    if _ACTIVE:
+        return next(reversed(_ACTIVE.values()))
+    return None
+
+
 def status_snapshot() -> dict:
-    """JSON-able live view: the active run (id, lifecycle phase, chunk
-    progress, live ETA straight from the ledger's ``chunk_commit``
-    accounting, health-code tallies) or ``active: null``."""
+    """JSON-able live view: every concurrent run (id, lifecycle phase,
+    chunk progress, live ETA straight from the ledger's ``chunk_commit``
+    accounting, health-code tallies) under ``runs``, plus ``active`` —
+    the most recently started of them — for single-run consumers."""
     with _STATE_LOCK:
         # "_"-prefixed keys are cross-event scratch (in-flight dispatch
         # stamps, accumulated program costs), not part of the payload
-        active = ({k: v for k, v in _ACTIVE.items()
-                   if not k.startswith("_")}
-                  if _ACTIVE is not None else None)
-    if active is not None:
-        active["elapsed_s"] = round(time.time() - active["t_start"], 3)
+        runs = [{k: v for k, v in st.items() if not k.startswith("_")}
+                for st in _ACTIVE.values()]
+    now = time.time()
+    for r in runs:
+        r["elapsed_s"] = round(now - r["t_start"], 3)
     return {
-        "time": time.time(),
+        "time": now,
         "metrics_enabled": enabled(),
-        "active": active,
+        "active": runs[-1] if runs else None,
+        "runs": runs,
         "runs_recorded": len(_RECENT),
     }
 
@@ -542,15 +581,16 @@ def recent_runs() -> list:
         return [dict(r) for r in reversed(_RECENT)]
 
 
-def observe_event(event, rec) -> None:
+def observe_event(event, rec, run_id=None) -> None:
     """Map one ledger event onto the live instruments + status state.
 
     Called from ``Run.emit`` (any emitting thread) AFTER the run lock is
-    released.  Telemetry must never kill the run: mapping errors are
-    counted and logged once, not raised.
+    released; ``run_id`` attributes the event to its run's live state so
+    concurrent runs never clobber each other.  Telemetry must never kill
+    the run: mapping errors are counted and logged once, not raised.
     """
     try:
-        _observe(event, rec)
+        _observe(event, rec, run_id)
     except Exception:  # noqa: BLE001 - metrics must never break emission
         global _OBSERVE_ERRORS
         with _STATE_LOCK:
@@ -563,11 +603,11 @@ def observe_event(event, rec) -> None:
                 "metrics observe_event failed for %r", event, exc_info=True)
 
 
-def _observe_program_cost(m, rec):
+def _observe_program_cost(m, rec, run_id=None):
     """``program_cost`` -> static gauges + per-run cost state.
 
-    Accumulates the active run's per-program statics under
-    ``_ACTIVE["_perf"]`` so chunk fetches can be turned into achieved
+    Accumulates the run's per-program statics under the run state's
+    ``"_perf"`` scratch so chunk fetches can be turned into achieved
     rates, and keeps the chunk-level arithmetic intensity gauge (sum of
     the supported executables' FLOPs over their bytes) current.
     """
@@ -579,9 +619,10 @@ def _observe_program_cost(m, rec):
                             program=prog)
     chunk_flops = chunk_bytes = 0.0
     with _STATE_LOCK:
-        if _ACTIVE is None:
+        state = _resolve_state(run_id)
+        if state is None:
             return
-        perf_state = _ACTIVE.setdefault("_perf", {"programs": {}})
+        perf_state = state.setdefault("_perf", {"programs": {}})
         perf_state["programs"][prog] = {
             "supported": supported,
             "flops": rec.get("flops"),
@@ -600,7 +641,7 @@ def _observe_program_cost(m, rec):
         m.arithmetic_intensity.set(chunk_flops / chunk_bytes)
 
 
-def _observe_utilization(m, rec):
+def _observe_utilization(m, rec, run_id=None):
     """``chunk_fetch`` -> achieved-rate gauges + the /status block.
 
     Joins the fetch timestamp against the chunk's recorded dispatch
@@ -610,12 +651,13 @@ def _observe_utilization(m, rec):
     """
     wall = perf_state = None
     with _STATE_LOCK:
-        if _ACTIVE is not None:
-            t0 = _ACTIVE.get("_dispatch_t", {}).pop(rec.get("chunk"), None)
+        state = _resolve_state(run_id)
+        if state is not None:
+            t0 = state.get("_dispatch_t", {}).pop(rec.get("chunk"), None)
             if isinstance(t0, (int, float)) \
                     and isinstance(rec.get("t"), (int, float)):
                 wall = float(rec["t"]) - float(t0)
-            perf_state = _ACTIVE.get("_perf")
+            perf_state = state.get("_perf")
     if not (wall and wall > 0 and perf_state
             and perf_state.get("chunk_flops")):
         return
@@ -643,8 +685,9 @@ def _observe_utilization(m, rec):
         m.chunk_mfu.observe(mfu)
         util["mfu"] = round(mfu, 6)
     with _STATE_LOCK:
-        if _ACTIVE is not None:
-            _ACTIVE["utilization"] = util
+        state = _resolve_state(run_id)
+        if state is not None:
+            state["utilization"] = util
 
 
 def _inc_transfer(m, rec, direction):
@@ -661,18 +704,25 @@ def _inc_transfer(m, rec, direction):
                              device="all")
 
 
-def _observe(event, rec):
-    global _ACTIVE
+def _watchdog_overdue_level():
+    """Current process-wide overdue-run count (the keyed aggregate in
+    robust.elastic — lazy import: elastic imports the ledger)."""
+    from ..robust import elastic
+
+    return len(elastic.overdue_runs())
+
+
+def _observe(event, rec, run_id=None):
     m = std()
     if m is NULL_STD:
         return
     if event == "run_start":
         m.runs_started.inc(kind=rec.get("kind", "?"))
-        m.run_active.set(1)
         fp = rec.get("fingerprint") or {}
+        rid = rec.get("run_id") or run_id
         with _STATE_LOCK:
-            _ACTIVE = {
-                "run_id": rec.get("run_id"),
+            _ACTIVE[rid] = {
+                "run_id": rid,
                 "kind": rec.get("kind"),
                 "t_start": rec.get("t", time.time()),
                 "phase": "plan",
@@ -687,46 +737,52 @@ def _observe(event, rec):
                 "status_counts": {},
                 "per_device_in_flight": {},
             }
+            m.run_active.set(len(_ACTIVE))
         if isinstance(fp, dict) and fp.get("n_designs") is not None:
             m.designs_total.set(int(fp["n_designs"]))
             m.designs_done.set(0)
     elif event == "plan":
         with _STATE_LOCK:
-            if _ACTIVE is not None:
-                _ACTIVE["n_chunks"] = rec.get("n_chunks")
-                _ACTIVE["chunk_size"] = rec.get("chunk_size")
-                _ACTIVE["mode"] = rec.get("mode")
-                _ACTIVE["phase"] = "compile"
+            state = _resolve_state(run_id)
+            if state is not None:
+                state["n_chunks"] = rec.get("n_chunks")
+                state["chunk_size"] = rec.get("chunk_size")
+                state["mode"] = rec.get("mode")
+                state["phase"] = "compile"
     elif event == "chunk_dispatch":
         m.chunks_dispatched.inc()
         in_flight = rec.get("in_flight", 0)
         m.chunks_in_flight.set(in_flight)
         with _STATE_LOCK:
-            if _ACTIVE is not None:
-                _ACTIVE["phase"] = "chunks"
+            state = _resolve_state(run_id)
+            if state is not None:
+                state["phase"] = "chunks"
                 # every mesh member executes its shard of every chunk,
                 # so each device's in-flight depth IS the pipeline depth
                 devices = rec.get("devices")
                 if devices:
-                    _ACTIVE["per_device_in_flight"] = {
+                    state["per_device_in_flight"] = {
                         str(d): in_flight for d in devices}
                 # dispatch timestamp, joined against chunk_fetch to turn
                 # the static program costs into achieved rates
-                _ACTIVE.setdefault("_dispatch_t", {})[
+                state.setdefault("_dispatch_t", {})[
                     rec.get("chunk")] = rec.get("t")
     elif event == "chunk_fetch":
         _inc_transfer(m, rec, "d2h")
-        _observe_utilization(m, rec)
+        _observe_utilization(m, rec, run_id)
     elif event == "chunk_commit":
         m.chunks_committed.inc()
-        m.watchdog_overdue.set(0)
+        # re-read the keyed aggregate instead of blanket-zeroing: one
+        # run committing must not mask another run's blown deadline
+        m.watchdog_overdue.set(_watchdog_overdue_level())
         done = rec.get("done", 0)
         m.designs_done.set(done)
         with _STATE_LOCK:
-            if _ACTIVE is not None:
-                _ACTIVE["chunks_done"] += 1
-                _ACTIVE["designs_done"] = done
-                _ACTIVE["eta_s"] = rec.get("eta_s")
+            state = _resolve_state(run_id)
+            if state is not None:
+                state["chunks_done"] += 1
+                state["designs_done"] = done
+                state["eta_s"] = rec.get("eta_s")
     elif event == "phase":
         name = rec.get("name", "")
         leaf = name.rsplit("/", 1)[-1]
@@ -735,8 +791,9 @@ def _observe(event, rec):
         if leaf in _STAGE_LEAVES or leaf == "compile":
             m.stage_seconds.observe(rec.get("seconds", 0.0), stage=leaf)
         with _STATE_LOCK:
-            if _ACTIVE is not None:
-                _ACTIVE["last_phase"] = name
+            state = _resolve_state(run_id)
+            if state is not None:
+                state["last_phase"] = name
     elif event == "compile_submitted":
         m.compiles_submitted.inc()
     elif event == "compile_start":
@@ -768,8 +825,9 @@ def _observe(event, rec):
         n = len(rec.get("designs") or ())
         m.status_transitions.inc(n, to=to)
         with _STATE_LOCK:
-            if _ACTIVE is not None:
-                tallies = _ACTIVE["status_counts"]
+            state = _resolve_state(run_id)
+            if state is not None:
+                tallies = state["status_counts"]
                 tallies[to] = tallies.get(to, 0) + n
     elif event == "checkpoint_flush":
         m.checkpoint_flushes.inc(ok=str(bool(rec.get("ok"))).lower())
@@ -777,8 +835,9 @@ def _observe(event, rec):
             m.checkpoint_flush_seconds.observe(rec["seconds"])
     elif event == "health_report":
         with _STATE_LOCK:
-            if _ACTIVE is not None and isinstance(rec.get("counts"), dict):
-                _ACTIVE["health_counts"] = dict(rec["counts"])
+            state = _resolve_state(run_id)
+            if state is not None and isinstance(rec.get("counts"), dict):
+                state["health_counts"] = dict(rec["counts"])
     elif event == "convergence_summary":
         for it in rec.get("iters") or ():
             if isinstance(it, (int, float)):
@@ -795,12 +854,12 @@ def _observe(event, rec):
     elif event == "audit_finding":
         m.audit_findings.inc(rule=rec.get("rule", "?"))
     elif event == "program_cost":
-        _observe_program_cost(m, rec)
+        _observe_program_cost(m, rec, run_id)
     elif event == "chaos_inject":
         m.chaos_injections.inc(seam=rec.get("seam", "?"))
     elif event == "chunk_timeout":
         m.chunk_timeouts.inc()
-        m.watchdog_overdue.set(1)
+        m.watchdog_overdue.set(max(1, _watchdog_overdue_level()))
     elif event == "device_lost":
         m.devices_lost.inc()
     elif event == "remesh":
@@ -809,10 +868,34 @@ def _observe(event, rec):
         m.preempts.inc(signal=str(rec.get("signal", "?")))
     elif event == "warning":
         m.warnings.inc()
+    # -- solve server (raft_tpu.serve) ------------------------------------
+    elif event == "request_accept":
+        m.requests_in_flight.inc()
+    elif event == "request_reject":
+        m.requests_total.inc(outcome="rejected")
+    elif event == "request_cancel":
+        m.requests_total.inc(outcome="cancelled")
+        m.requests_in_flight.dec()
+    elif event == "request_deadline":
+        m.requests_total.inc(outcome="deadline")
+        m.requests_in_flight.dec()
+    elif event == "request_done":
+        m.requests_total.inc(
+            outcome="ok" if rec.get("ok") else "error")
+        m.requests_in_flight.dec()
+        if rec.get("seconds") is not None:
+            m.request_latency.observe(rec["seconds"])
+    elif event == "serve_round":
+        m.serve_rounds.inc()
+        m.coalesced_designs.inc(int(rec.get("designs") or 0))
+    elif event == "breaker_trip":
+        m.breaker_trips.inc()
     elif event == "run_end":
         ok = bool(rec.get("ok"))
         with _STATE_LOCK:
-            active, _ACTIVE = _ACTIVE, None
+            rid = run_id if run_id is not None else (
+                next(reversed(_ACTIVE)) if _ACTIVE else None)
+            active = _ACTIVE.pop(rid, None) if rid is not None else None
             kind = (active or {}).get("kind", "?")
             summary = {
                 "run_id": (active or {}).get("run_id"),
@@ -829,16 +912,17 @@ def _observe(event, rec):
                 summary["span_s"] = round(
                     summary["t_end"] - summary["t_start"], 3)
             _RECENT.append(summary)
-        m.run_active.set(0)
-        m.chunks_in_flight.set(0)
+            m.run_active.set(len(_ACTIVE))
+            if not _ACTIVE:
+                m.chunks_in_flight.set(0)
         m.runs_finished.inc(kind=kind, ok=str(ok).lower())
 
 
 def reset() -> None:
     """Clear all instrument data and live state (test isolation)."""
-    global _STD, _ACTIVE, _OBSERVE_ERRORS
+    global _STD, _OBSERVE_ERRORS
     with _STATE_LOCK:
-        _ACTIVE = None
+        _ACTIVE.clear()
         _RECENT.clear()
         _OBSERVE_ERRORS = 0
     with _STD_LOCK:

@@ -88,6 +88,31 @@ EVENTS: dict[str, tuple] = {
     "preempt": ("signal",),                     # graceful-shutdown drain;
                                                 #   + done, n_designs,
                                                 #   checkpoint
+    # -- solve server (raft_tpu.serve) ------------------------------------
+    "request_accept": ("request", "tenant", "designs"),
+                                                # admitted to the queue;
+                                                #   + priority, deadline_s
+    "request_reject": ("request", "reason"),    # load-shed / invalid;
+                                                #   reason: 'saturated' |
+                                                #   'too_large' | 'deadline'
+                                                #   | 'breaker' | 'closed';
+                                                #   + tenant, designs
+    "request_cancel": ("request",),             # caller cancelled; + tenant
+    "request_deadline": ("request",),           # deadline passed before
+                                                #   completion; + tenant,
+                                                #   deadline_s
+    "request_done": ("request", "ok"),          # results delivered (or the
+                                                #   request failed);
+                                                #   + tenant, seconds, error
+    "serve_round": ("round", "requests", "designs"),
+                                                # one coalesced dispatch:
+                                                #   n requests packed into
+                                                #   one grid sweep; + run_id
+                                                #   of the child sweep run,
+                                                #   chunks
+    "breaker_trip": ("fingerprint",),           # circuit breaker fast-fails
+                                                #   a design fingerprint;
+                                                #   + failures, cooldown_s
     # -- potential-flow BEM tier (raft_tpu.hydro.bem_batch) ---------------
     "bem_precompute": ("cache", "designs"),     # batched radiation/
                                                 #   diffraction solve per

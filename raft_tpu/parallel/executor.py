@@ -48,7 +48,8 @@ from ..obs import metrics as obs_metrics
 
 __all__ = ["gather_rows", "chunk_selector", "start_host_fetch",
            "wait_for_executables", "CheckpointWriter", "FaultIsolator",
-           "ChunkTimeout", "ChunkTimer", "call_with_deadline"]
+           "ChunkTimeout", "ChunkTimer", "LatencyWindow",
+           "call_with_deadline"]
 
 _LOG = obs_log.get_logger("parallel.executor")
 
@@ -129,6 +130,43 @@ class ChunkTimer:
             return self._cold
         median = sorted(obs)[len(obs) // 2]
         return max(self._floor, self._mult * median)
+
+
+class LatencyWindow:
+    """Rolling latency window with percentile readout.
+
+    The serve layer's request-latency companion to :class:`ChunkTimer`:
+    observations arrive from delivery paths on worker threads, and the
+    p50/p99 readout backs the server's ``stats()`` + the history-store
+    ``serve_p99_s`` gate.  Percentiles use the nearest-rank method on
+    the last ``window`` observations — deterministic, no interpolation.
+    """
+
+    def __init__(self, window=512):
+        self._window = int(window)
+        self._obs = []
+        self._count = 0
+        self._lock = threading.Lock()
+
+    def observe(self, seconds):
+        with self._lock:
+            self._count += 1
+            self._obs.append(float(seconds))
+            del self._obs[:-self._window]
+
+    def count(self) -> int:
+        with self._lock:
+            return self._count
+
+    def percentile(self, q) -> float | None:
+        """Nearest-rank percentile (``q`` in [0, 100]) of the window,
+        or None before any observation."""
+        with self._lock:
+            obs = sorted(self._obs)
+        if not obs:
+            return None
+        rank = max(1, -(-int(len(obs) * float(q)) // 100))
+        return obs[min(rank, len(obs)) - 1]
 
 
 def wait_for_executables(tasks, run=None):

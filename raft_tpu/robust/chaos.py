@@ -24,7 +24,20 @@ oom_upload      the resident device upload raises :class:`ChaosOOM`
                 host packing
 preempt         the process sends itself SIGTERM at a chunk boundary,
                 exercising the graceful-shutdown drain + resumable
-                checkpoint path
+                checkpoint path.  With a resident solve server active
+                (:func:`register_preempt_hook`), the signal is routed
+                through the server's drain path instead — the server
+                checkpoints pending requests and KEEPS serving, because
+                a self-SIGTERM that kills a resident process would turn
+                a drill into an outage
+req_flood       the solve server injects ``n`` synthetic single-design
+                requests ahead of round composition, driving the
+                admission bound (excess load sheds via the 429 path)
+slow_client     delivery of one request's results stalls ``secs``
+                seconds (a slow reader), without blocking cohabiting
+                requests
+cancel_storm    ``n`` queued requests are cancelled at round
+                composition, exercising row masking under churn
 ==============  ============================================================
 
 Spec grammar (``RAFT_TPU_CHAOS`` or ``sweep(..., chaos=...)``)::
@@ -37,8 +50,10 @@ Spec grammar (``RAFT_TPU_CHAOS`` or ``sweep(..., chaos=...)``)::
 
 Rule keys: ``p`` (fire probability, default 1), ``chunk`` (fire only at
 this chunk index), ``n`` (max fires; default 1 for chunk-targeted rules
-so a retried chunk succeeds, unlimited otherwise), ``secs`` (hang
-duration), ``device`` (device id reported lost).
+so a retried chunk succeeds, unlimited otherwise), ``secs`` (hang /
+slow-client duration), ``device`` (device id reported lost), ``count``
+(request-layer payload: synthetic requests injected by ``req_flood`` /
+requests cancelled by ``cancel_storm``; default 1).
 
 Replayability: chunk-targeted rules fire at exactly the named chunk;
 probabilistic rolls hash (seed, run fingerprint, seam, chunk-or-call
@@ -68,12 +83,15 @@ __all__ = [
     "ChaosPlan",
     "parse_spec",
     "plan_for",
+    "register_preempt_hook",
+    "unregister_preempt_hook",
 ]
 
 SEAMS = ("hang", "poison_fetch", "device_lost", "compile_crash",
-         "ckpt_fail", "oom_upload", "preempt")
+         "ckpt_fail", "oom_upload", "preempt",
+         "req_flood", "slow_client", "cancel_storm")
 
-_RULE_KEYS = ("p", "chunk", "n", "secs", "device")
+_RULE_KEYS = ("p", "chunk", "n", "secs", "device", "count")
 
 
 class ChaosError(RuntimeError):
@@ -103,7 +121,7 @@ class ChaosRule:
     """One parsed spec rule; fire bookkeeping lives on the instance."""
 
     def __init__(self, seam, *, p=1.0, chunk=None, n=None, secs=30.0,
-                 device=None, text=""):
+                 device=None, count=1, text=""):
         self.seam = seam
         self.p = float(p)
         self.chunk = None if chunk is None else int(chunk)
@@ -112,6 +130,9 @@ class ChaosRule:
         self.n = (1 if chunk is not None else None) if n is None else int(n)
         self.secs = float(secs)
         self.device = None if device is None else int(device)
+        # payload size for the request-layer seams: how many synthetic
+        # requests req_flood injects / how many cancel_storm cancels
+        self.count = max(1, int(count))
         self.text = text or seam
         self.fired = 0
         self.calls = 0
@@ -146,6 +167,39 @@ def parse_spec(spec) -> list:
             kw[key] = float(val) if key in ("p", "secs") else int(val)
         rules.append(ChaosRule(seam, text=part, **kw))
     return rules
+
+
+# Resident-server preempt routing: a long-lived solve server registers
+# its drain entry point here; while registered, the preempt seam (and a
+# real SIGTERM via ShutdownGuard, see robust.elastic) drains pending
+# work to a checkpoint and keeps the process alive instead of letting a
+# self-SIGTERM take the whole service down.  Process-wide because the
+# seam fires from whatever thread runs the sweep chunk loop.
+_PREEMPT_HOOK = None
+_PREEMPT_HOOK_LOCK = threading.Lock()
+
+
+def register_preempt_hook(hook) -> None:
+    """Route preempt faults through ``hook()`` (a resident server's
+    drain path) instead of a process self-SIGTERM.  The hook returns
+    True when it handled the preempt (the process keeps serving)."""
+    global _PREEMPT_HOOK
+    with _PREEMPT_HOOK_LOCK:
+        _PREEMPT_HOOK = hook
+
+
+def unregister_preempt_hook(hook=None) -> None:
+    """Remove the preempt hook (only ``hook`` when given, so an old
+    server shutting down cannot unhook its replacement)."""
+    global _PREEMPT_HOOK
+    with _PREEMPT_HOOK_LOCK:
+        if hook is None or _PREEMPT_HOOK is hook:
+            _PREEMPT_HOOK = None
+
+
+def preempt_hook():
+    with _PREEMPT_HOOK_LOCK:
+        return _PREEMPT_HOOK
 
 
 def _roll(seed, fingerprint, seam, key) -> float:
@@ -241,10 +295,16 @@ class ChaosPlan:
                          f"({rule.text})")
 
     def maybe_preempt(self, chunk) -> bool:
-        """Deliver SIGTERM to this process at a chunk boundary."""
+        """Deliver SIGTERM to this process at a chunk boundary — or,
+        with a resident server's drain hook registered, route the
+        preempt through the server's drain path (checkpoint pending
+        requests, keep serving) instead of killing the process."""
         rule = self.fires("preempt", key=chunk)
         if rule is None:
             return False
+        hook = preempt_hook()
+        if hook is not None and hook():
+            return True
         os.kill(os.getpid(), signal.SIGTERM)
         return True
 

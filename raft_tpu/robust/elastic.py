@@ -45,6 +45,7 @@ __all__ = [
     "Watchdog",
     "ShutdownGuard",
     "deadline_exceeded",
+    "overdue_runs",
     "is_device_loss",
     "is_oom",
     "surviving_devices",
@@ -52,22 +53,36 @@ __all__ = [
 
 _LOG = obs_log.get_logger("robust.elastic")
 
-# -- watchdog overdue flag (read by obs.live's /healthz) --------------------
+# -- watchdog overdue state (read by obs.live's /healthz) -------------------
+#
+# Keyed by run (one Watchdog per sweep attempt) so concurrent runs — the
+# solve server drives many at once — cannot clobber each other's flag:
+# /healthz aggregates ACROSS runs and reports unhealthy while ANY of
+# them has a chunk past its deadline.
 
 _OVERDUE_LOCK = threading.Lock()
-_OVERDUE = False
+_OVERDUE: set = set()
 
 
-def _set_overdue(flag):
-    global _OVERDUE
+def _set_overdue(flag, key="default"):
     with _OVERDUE_LOCK:
-        _OVERDUE = bool(flag)
+        if flag:
+            _OVERDUE.add(key)
+        else:
+            _OVERDUE.discard(key)
 
 
 def deadline_exceeded() -> bool:
-    """True while some chunk is past its watchdog deadline (process-wide)."""
+    """True while some chunk of ANY active run is past its watchdog
+    deadline (process-wide aggregate over concurrent runs)."""
     with _OVERDUE_LOCK:
-        return _OVERDUE
+        return bool(_OVERDUE)
+
+
+def overdue_runs() -> list:
+    """The run keys currently past a watchdog deadline (sorted)."""
+    with _OVERDUE_LOCK:
+        return sorted(str(k) for k in _OVERDUE)
 
 
 # -- typed control-flow exceptions ------------------------------------------
@@ -188,6 +203,10 @@ class Watchdog:
                                  cfg["watchdog_mult"],
                                  cfg["watchdog_cold_s"])
         self._run = run
+        # overdue key: the run id when the ledger is on (so /healthz can
+        # name the offending run), else instance identity — either way
+        # concurrent watchdogs never share a flag
+        self._key = getattr(run, "run_id", None) or f"watchdog-{id(self):x}"
 
     def deadline(self) -> float:
         return self._timer.deadline()
@@ -212,12 +231,12 @@ class Watchdog:
         try:
             out = call_with_deadline(fn, remaining, what=what)
         except ChunkTimeout:
-            _set_overdue(True)
+            _set_overdue(True, key=self._key)
             self._run.emit("chunk_timeout", chunk=chunk,
                            deadline_s=round(deadline, 3),
                            waited_s=round(time.perf_counter() - t0, 3))
             raise
-        _set_overdue(False)
+        _set_overdue(False, key=self._key)
         start = since if since is not None else t0
         self._timer.observe(time.perf_counter() - start)
         return out

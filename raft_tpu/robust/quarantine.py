@@ -19,6 +19,7 @@ mechanism.
 from __future__ import annotations
 
 import hashlib
+import threading
 import time
 
 import numpy as np
@@ -26,7 +27,7 @@ import numpy as np
 from ..obs import ledger as obs_ledger
 from ..obs import log as obs_log
 
-__all__ = ["run_isolated"]
+__all__ = ["run_isolated", "CircuitBreaker"]
 
 _LOG = obs_log.get_logger("robust.quarantine")
 
@@ -202,3 +203,73 @@ def _run_isolated(run, idx, retries=1, display=0, align=1,
         masks.append(mask)
     quarantined = np.concatenate(masks)
     return _merge(parts, halves, n), quarantined
+
+
+class CircuitBreaker:
+    """Design-fingerprint circuit breaker for the solve server.
+
+    ``run_isolated`` pays a retry + bisect every time a poison design
+    comes through; a tenant resubmitting the same broken geometry turns
+    that into a quarantine storm.  The breaker remembers recent
+    quarantines by design fingerprint and, once one accumulates
+    ``threshold`` failures, *trips*: the fingerprint fast-fails at
+    admission for ``cooldown_s`` without ever being dispatched.  After
+    the cooldown the fingerprint gets one probe attempt (half-open); a
+    clean solve resets it, another quarantine re-trips the cooldown.
+
+    Thread-safe; time injection (``clock``) keeps the tests clock-free.
+    """
+
+    def __init__(self, threshold=2, cooldown_s=300.0,
+                 run=obs_ledger.NULL_RUN, clock=time.monotonic):
+        self.threshold = max(1, int(threshold))
+        self.cooldown_s = float(cooldown_s)
+        self._run = run
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._failures: dict = {}   # fp -> consecutive quarantine count
+        self._open_until: dict = {}  # fp -> trip expiry (monotonic)
+
+    def allows(self, fp) -> bool:
+        """False while ``fp`` is tripped (fast-fail, do not dispatch)."""
+        now = self._clock()
+        with self._lock:
+            until = self._open_until.get(fp)
+            if until is None:
+                return True
+            if now < until:
+                return False
+            # half-open: let one attempt probe; keep the failure count
+            # so another quarantine re-trips immediately
+            del self._open_until[fp]
+            return True
+
+    def record_failure(self, fp) -> bool:
+        """Count one quarantine for ``fp``; True when this trip opened
+        the breaker (a ``breaker_trip`` event is emitted exactly once
+        per trip)."""
+        with self._lock:
+            n = self._failures.get(fp, 0) + 1
+            self._failures[fp] = n
+            if n < self.threshold:
+                return False
+            already_open = fp in self._open_until
+            self._open_until[fp] = self._clock() + self.cooldown_s
+        if not already_open:
+            self._run.emit("breaker_trip", fingerprint=str(fp),
+                           failures=int(n),
+                           cooldown_s=round(self.cooldown_s, 3))
+        return not already_open
+
+    def record_success(self, fp) -> None:
+        """A clean solve closes the breaker and forgets the history."""
+        with self._lock:
+            self._failures.pop(fp, None)
+            self._open_until.pop(fp, None)
+
+    def tripped(self) -> list:
+        """Currently open fingerprints (sorted; monitoring/stats)."""
+        now = self._clock()
+        with self._lock:
+            return sorted(fp for fp, until in self._open_until.items()
+                          if now < until)

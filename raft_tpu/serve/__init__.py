@@ -1,0 +1,24 @@
+"""Sweep-as-a-service: resident multi-tenant solve server.
+
+:class:`SolveServer` keeps one sweep configuration's executables and
+resident variant batch warm on-device and coalesces concurrent small
+requests into shared fixed-shape chunk rounds;
+:class:`~raft_tpu.serve.http.ServeFront` (imported lazily from
+``raft_tpu.serve.http``) puts a stdlib HTTP surface in front of it.
+See docs/serving.md for the coalescing and robustness contract.
+"""
+
+from .server import (DeadlineExceeded, RequestCancelled, RequestFailed,
+                     RequestRejected, ServerSaturated, SolveServer, Ticket,
+                     point_fingerprint)
+
+__all__ = [
+    "SolveServer",
+    "Ticket",
+    "RequestRejected",
+    "ServerSaturated",
+    "RequestCancelled",
+    "DeadlineExceeded",
+    "RequestFailed",
+    "point_fingerprint",
+]

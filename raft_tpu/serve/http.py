@@ -1,0 +1,197 @@
+"""Thin stdlib HTTP front for :class:`~raft_tpu.serve.server.SolveServer`.
+
+Extends the ``obs/live.py`` pattern (stdlib ``ThreadingHTTPServer`` on a
+daemon thread, loopback bind by default, JSON bodies) with a
+request-accepting surface:
+
+* ``POST /solve`` — body ``{"points": [[...], ...], "tenant": str,
+  "priority": int, "deadline_s": float}`` (only ``points`` required).
+  202 + ``{"request_id": ...}`` on admission; 429 on saturation; 400 on
+  any other typed rejection (``reason`` names the admission decision).
+* ``GET /result/<id>`` — 200 + results once delivered (arrays as
+  nested lists), 202 while pending, 410 when the request failed
+  (typed ``error``/``reason``), 404 for an unknown id.
+* ``POST /cancel/<id>`` — 200 ``{"cancelled": bool}``.
+* ``GET /stats`` — the server's live counters / latency percentiles.
+* ``GET /healthz`` — proxies the aggregate watchdog liveness check
+  (same contract as the obs endpoint).
+
+Results are retained for ``result_ttl_s`` after delivery so a client
+can poll; cancellations and failures are reported once and retained the
+same way.  The front is unauthenticated — bind loopback unless you are
+fronting it yourself.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+import numpy as np
+
+from .server import RequestRejected, SolveServer
+
+__all__ = ["ServeFront"]
+
+
+def _jsonable(obj):
+    if isinstance(obj, np.ndarray):
+        return obj.tolist()
+    if isinstance(obj, (np.integer, np.floating, np.bool_)):
+        return obj.item()
+    if isinstance(obj, (tuple, set)):
+        return list(obj)
+    raise TypeError(f"not JSON-serializable: {type(obj).__name__}")
+
+
+class _Handler(BaseHTTPRequestHandler):
+    server_version = "raft-tpu-serve/1"
+
+    def _send(self, code, payload):
+        data = json.dumps(payload, default=_jsonable).encode("utf-8")
+        self.send_response(code)
+        self.send_header("Content-Type", "application/json")
+        self.send_header("Content-Length", str(len(data)))
+        self.end_headers()
+        self.wfile.write(data)
+
+    @property
+    def _front(self):
+        return self.server.front  # type: ignore[attr-defined]
+
+    def do_GET(self):  # noqa: N802 - http.server API
+        path = self.path.split("?", 1)[0].rstrip("/") or "/"
+        try:
+            if path == "/stats":
+                self._send(200, self._front.solver.stats())
+            elif path.startswith("/result/"):
+                self._send(*self._front._result_payload(
+                    path[len("/result/"):]))
+            elif path == "/healthz":
+                from ..robust import elastic
+
+                overdue = elastic.overdue_runs()
+                self._send(503 if overdue else 200,
+                           {"ok": not overdue, "overdue_runs": overdue})
+            elif path == "/":
+                self._send(200, {"endpoints": [
+                    "POST /solve", "GET /result/<id>", "POST /cancel/<id>",
+                    "GET /stats", "GET /healthz"]})
+            else:
+                self._send(404, {"error": "not found", "path": path})
+        except Exception as e:  # noqa: BLE001 - keep the thread alive
+            try:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            except OSError:
+                pass
+
+    def do_POST(self):  # noqa: N802 - http.server API
+        path = self.path.split("?", 1)[0].rstrip("/")
+        try:
+            if path == "/solve":
+                n = int(self.headers.get("Content-Length") or 0)
+                try:
+                    body = json.loads(self.rfile.read(n) or b"{}")
+                except json.JSONDecodeError as e:
+                    self._send(400, {"error": f"bad JSON: {e}"})
+                    return
+                self._send(*self._front._solve_payload(body))
+            elif path.startswith("/cancel/"):
+                rid = path[len("/cancel/"):]
+                self._send(200, {
+                    "request_id": rid,
+                    "cancelled": self._front._cancel(rid)})
+            else:
+                self._send(404, {"error": "not found", "path": path})
+        except Exception as e:  # noqa: BLE001 - keep the thread alive
+            try:
+                self._send(500, {"error": f"{type(e).__name__}: {e}"})
+            except OSError:
+                pass
+
+    def log_message(self, fmt, *args):
+        from ..obs import log as obs_log
+
+        obs_log.get_logger("serve.http").debug(
+            "%s %s", self.address_string(), fmt % args)
+
+
+class ServeFront:
+    """HTTP front over one :class:`SolveServer` (daemon thread)."""
+
+    def __init__(self, solver: SolveServer, host=None, port=None,
+                 result_ttl_s=300.0):
+        self.solver = solver
+        host = solver.cfg["host"] if host is None else host
+        port = solver.cfg["port"] if port is None else int(port)
+        self._tickets: dict = {}     # rid -> Ticket
+        self._expiry: dict = {}      # rid -> delivery deadline for GC
+        self._ttl = float(result_ttl_s)
+        self._lock = threading.Lock()
+        self._httpd = ThreadingHTTPServer((host, port), _Handler)
+        self._httpd.front = self  # type: ignore[attr-defined]
+        self._httpd.daemon_threads = True
+        self.host, self.port = self._httpd.server_address[:2]
+        self._thread = threading.Thread(
+            target=self._httpd.serve_forever, name="raft-tpu-serve-http",
+            daemon=True)
+        self._thread.start()
+
+    @property
+    def url(self) -> str:
+        return f"http://{self.host}:{self.port}"
+
+    def close(self):
+        self._httpd.shutdown()
+        self._httpd.server_close()
+        self._thread.join(timeout=5)
+
+    # -- request handling (called from handler threads) -------------------
+
+    def _gc(self, now):
+        dead = [rid for rid, t in self._expiry.items() if now >= t]
+        for rid in dead:
+            self._tickets.pop(rid, None)
+            self._expiry.pop(rid, None)
+
+    def _solve_payload(self, body):
+        points = body.get("points")
+        if not isinstance(points, list):
+            return 400, {"error": "body must carry 'points': [[...], ...]"}
+        try:
+            ticket = self.solver.submit(
+                points, tenant=str(body.get("tenant", "default")),
+                priority=body.get("priority"),
+                deadline_s=body.get("deadline_s"))
+        except RequestRejected as e:
+            return e.http_status, {"error": str(e), "reason": e.reason}
+        with self._lock:
+            self._gc(time.monotonic())
+            self._tickets[ticket.id] = ticket
+        return 202, {"request_id": ticket.id}
+
+    def _result_payload(self, rid):
+        with self._lock:
+            ticket = self._tickets.get(rid)
+        if ticket is None:
+            return 404, {"error": "unknown request id", "request_id": rid}
+        if not ticket.done:
+            return 202, {"request_id": rid, "status": "pending"}
+        with self._lock:
+            self._expiry.setdefault(rid, time.monotonic() + self._ttl)
+        try:
+            result = ticket.result(timeout=0)
+        except Exception as e:  # noqa: BLE001 - typed failure to wire
+            return 410, {"request_id": rid, "status": "failed",
+                         "error": str(e),
+                         "reason": getattr(e, "reason",
+                                           type(e).__name__)}
+        return 200, {"request_id": rid, "status": "done",
+                     "result": result}
+
+    def _cancel(self, rid) -> bool:
+        with self._lock:
+            ticket = self._tickets.get(rid)
+        return False if ticket is None else ticket.cancel()

@@ -1,0 +1,789 @@
+"""Resident multi-tenant solve server with cross-request coalescing.
+
+:class:`SolveServer` is the long-lived front of ROADMAP item 1: it owns
+one sweep configuration (base design, axes, sea states, iteration count,
+optional aero-servo wind cases) and keeps the chunk executables, the
+template memo, and the resident variant batch warm on-device forever.
+Callers submit small design-point batches (1-50 points each); the
+server packs pending requests into *rounds* — one ``sweep(grid=...)``
+call over the concatenated points — so every request shares the same
+fixed-shape padded chunks the mesh executor already runs.  Coalescing
+is the whole throughput story: N cohabiting requests cost the chunks of
+ONE sweep, not N.
+
+Robustness contract (docs/serving.md spells out the full matrix):
+
+* **Admission / backpressure** — the pending-design queue is bounded;
+  a full queue rejects with :class:`ServerSaturated` (HTTP 429 on the
+  wire), an oversized request with :class:`RequestRejected`
+  (``too_large``).  Rejection is *typed and immediate* — the server
+  never silently queues unbounded work.
+* **Priorities + tenant fairness** — lower ``priority`` schedules
+  first; within a priority class, round composition round-robins across
+  tenants so one chatty tenant cannot starve the rest.
+* **Deadlines** — a request past its deadline is failed (typed
+  :class:`DeadlineExceeded`) at round composition — its rows are never
+  dispatched — or at delivery when the round outlived it.  A round
+  whose members carry deadlines runs under
+  :func:`~raft_tpu.parallel.executor.call_with_deadline` (the
+  watchdog's enforcement primitive) sized to the latest member deadline
+  plus a grace, so a wedged round cannot outlive every caller's
+  interest.
+* **Cancellation** — cancelling a queued request masks its rows out of
+  all future rounds; cancelling mid-round discards its slice at
+  delivery.  Cohabiting requests are never stalled either way.
+* **Quarantine isolation** — a poison design inside a shared chunk is
+  bisected out by ``run_isolated`` *inside* the sweep; cohabiting rows
+  still compute.  The per-request result carries its own ``status``
+  rows, so one tenant's NaN storm degrades only that tenant's answers.
+* **Circuit breaker** — repeated quarantines of the same design
+  fingerprint trip :class:`~raft_tpu.robust.quarantine.CircuitBreaker`;
+  further submissions of that fingerprint fast-fail at admission for
+  the cooldown instead of burning bisection rounds.
+* **Graceful degradation** — ``close(drain=True)`` (and SIGTERM via the
+  chaos ``preempt`` routing, :func:`raft_tpu.robust.chaos.
+  register_preempt_hook`) drains: the in-flight round completes and
+  delivers, queued requests checkpoint to a resumable JSON
+  (``drain_path``) and fail typed.  Device loss mid-round re-meshes
+  inside ``sweep()`` and the round completes on the survivors — no
+  request fails.
+
+Bit-identity: rounds run the same executables at the same chunk extent
+as a direct ``sweep(grid=points, chunk_size=cfg['chunk_size'])`` call,
+and the chunk programs are vmapped row-independent — so each request's
+slice of a coalesced round is bit-identical to solving it alone
+(pinned by tests/test_serve.py and scripts/serve_check.py).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import itertools
+import json
+import os
+import threading
+import time
+
+import numpy as np
+
+from ..config import serve_config
+from ..obs import ledger as obs_ledger
+from ..obs import log as obs_log
+from ..parallel.executor import LatencyWindow, call_with_deadline
+from ..robust import STATUS_QUARANTINED
+from ..robust import chaos as chaos_mod
+from ..robust.quarantine import CircuitBreaker
+
+__all__ = [
+    "SolveServer",
+    "Ticket",
+    "RequestRejected",
+    "ServerSaturated",
+    "RequestCancelled",
+    "DeadlineExceeded",
+    "RequestFailed",
+]
+
+_LOG = obs_log.get_logger("serve.server")
+
+# per-request result keys sliced out of a round's sweep output
+_RESULT_KEYS = ("motion_std", "AxRNA_std", "mass", "displacement", "GMT",
+                "status")
+
+
+class RequestRejected(RuntimeError):
+    """Typed admission rejection; ``reason`` is the ledger reason code
+    (``saturated`` | ``too_large`` | ``deadline`` | ``breaker`` |
+    ``closed``)."""
+
+    http_status = 400
+
+    def __init__(self, reason, detail=""):
+        super().__init__(f"request rejected ({reason})"
+                         + (f": {detail}" if detail else ""))
+        self.reason = reason
+
+
+class ServerSaturated(RequestRejected):
+    """The bounded queue is full — shed load, retry later (HTTP 429)."""
+
+    http_status = 429
+
+    def __init__(self, detail=""):
+        super().__init__("saturated", detail)
+
+
+class RequestCancelled(RuntimeError):
+    """The request was cancelled before delivery."""
+
+
+class DeadlineExceeded(RuntimeError):
+    """The request's deadline passed before its results were ready."""
+
+
+class RequestFailed(RuntimeError):
+    """The request's round failed after exhausting its retry budget."""
+
+
+def point_fingerprint(point) -> str:
+    """Stable fingerprint of one design point (the circuit-breaker key
+    and the chaos-plan key for request-layer seams)."""
+    h = hashlib.sha256()
+    for v in point:
+        arr = np.asarray(v)
+        h.update(str(arr.dtype).encode())
+        h.update(str(arr.shape).encode())
+        h.update(np.ascontiguousarray(arr).tobytes())
+    return h.hexdigest()[:16]
+
+
+class _Request:
+    """Internal request record; callers hold the :class:`Ticket` view."""
+
+    __slots__ = ("id", "tenant", "points", "fps", "priority", "deadline",
+                 "deadline_s", "t_accept", "seq", "retries_left",
+                 "cancelled", "event", "result", "error", "synthetic")
+
+    def __init__(self, rid, tenant, points, fps, priority, deadline,
+                 deadline_s, seq, retries_left, synthetic=False):
+        self.id = rid
+        self.tenant = tenant
+        self.points = points
+        self.fps = fps
+        self.priority = priority
+        self.deadline = deadline        # absolute monotonic, or None
+        self.deadline_s = deadline_s    # as submitted (ledger)
+        self.t_accept = time.monotonic()
+        self.seq = seq
+        self.retries_left = retries_left
+        self.cancelled = False
+        self.event = threading.Event()
+        self.result = None
+        self.error = None
+        self.synthetic = synthetic      # chaos req_flood filler
+
+    def expired(self, now=None) -> bool:
+        return (self.deadline is not None
+                and (now if now is not None else time.monotonic())
+                >= self.deadline)
+
+
+class Ticket:
+    """Caller-facing handle for one submitted request."""
+
+    def __init__(self, server, req):
+        self._server = server
+        self._req = req
+
+    @property
+    def id(self) -> str:
+        return self._req.id
+
+    @property
+    def done(self) -> bool:
+        return self._req.event.is_set()
+
+    def result(self, timeout=None) -> dict:
+        """Block for this request's results.
+
+        Returns the per-request result dict (``grid``, ``motion_std``,
+        ``AxRNA_std``, ``mass``, ``displacement``, ``GMT``, ``status``,
+        ``health``) or raises the request's typed failure
+        (:class:`RequestCancelled`, :class:`DeadlineExceeded`,
+        :class:`RequestFailed`).  ``timeout=None`` waits forever.
+        """
+        if not self._req.event.wait(timeout):
+            raise TimeoutError(
+                f"request {self._req.id} still pending after {timeout}s")
+        if self._req.error is not None:
+            raise self._req.error
+        return self._req.result
+
+    def cancel(self) -> bool:
+        """Cancel the request; True when the cancel landed before
+        delivery (False when results were already delivered)."""
+        return self._server._cancel(self._req)
+
+
+class SolveServer:
+    """Long-lived coalescing solve server over one sweep configuration.
+
+    Parameters mirror :func:`raft_tpu.sweep.sweep` minus the axes'
+    *values* — requests supply the design points; ``axes`` fixes the
+    axis *paths* (one value per path per point).  ``config`` overrides
+    :func:`raft_tpu.config.serve_config` keys; ``chaos`` arms the
+    request-layer chaos seams (``req_flood`` / ``slow_client`` /
+    ``cancel_storm``) on the server's own plan — sweep-level seams go
+    through :meth:`inject_chaos`, which arms the NEXT round's sweep.
+    """
+
+    def __init__(self, base_design, axes, sea_states, *, n_iter=15,
+                 wind=None, devices=None, device=None, health=None,
+                 config=None, chaos=None):
+        self.cfg = serve_config(config)
+        self._base_design = base_design
+        self._axes = [(p, list(v)) for p, v in axes]
+        self._sea_states = list(sea_states)
+        self._n_iter = int(n_iter)
+        self._wind = wind
+        self._devices = devices
+        self._device = device
+        self._health = health
+
+        self._lock = threading.Condition()
+        self._pending: list = []       # admitted, not yet in a round
+        self._pending_designs = 0
+        self._round_no = 0
+        self._req_seq = itertools.count()
+        self._tenant_rr: list = []     # round-robin order memory
+        self._closing = False
+        self._closed = threading.Event()
+        self._worker = None
+        self._next_chaos = None        # one-shot sweep-level spec
+        self._latency = LatencyWindow()
+        self._t_started = None
+        self._counts = {"accepted": 0, "rejected": 0, "completed": 0,
+                        "failed": 0, "cancelled": 0, "deadline": 0,
+                        "rounds": 0, "coalesced_designs": 0, "drains": 0}
+
+        self._run = obs_ledger.NULL_RUN
+        if obs_ledger.observing():
+            from ..sweep import _design_hash
+
+            self._run = obs_ledger.start_run(
+                "serve",
+                fingerprint={"design": _design_hash(base_design)[:16],
+                             "axes": [str(p) for p, _ in self._axes],
+                             "n_cases": len(self._sea_states)},
+                meta={"n_iter": self._n_iter,
+                      "chunk_size": int(self.cfg["chunk_size"]),
+                      "wind": wind is not None})
+        self._plan = chaos_mod.plan_for(
+            "serve", run=self._run, chaos=chaos)
+        self._breaker = CircuitBreaker(
+            threshold=self.cfg["breaker_threshold"],
+            cooldown_s=self.cfg["breaker_cooldown_s"], run=self._run)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def _bucket(self, n) -> int:
+        """Round a round's design count up to its size bucket.
+
+        Rounds are padded (row repetition — rows are vmap-independent,
+        so padding never changes a real row's bits) to a power-of-two
+        multiple of ``chunk_size``.  Two invariants follow: the chunk
+        extent is ALWAYS ``chunk_size`` (a 1-design round runs the same
+        executables as a full one), and the resident variant-batch
+        shape takes at most ``log2(max_round/chunk) + 1`` distinct
+        values — so the executable set is small, warmable, and a warmed
+        server dispatches rounds of any composition with zero real XLA
+        compiles.
+        """
+        b = int(self.cfg["chunk_size"])
+        while b < n:
+            b *= 2
+        return b
+
+    def _warm_pad(self, grid) -> list:
+        return grid + [grid[0]] * (self._bucket(len(grid)) - len(grid))
+
+    def start(self, warm=True):
+        """Warm the executables and start the round worker.
+
+        ``warm=True`` runs :func:`~raft_tpu.sweep.precompile` over one
+        chunk-sized grid (compile the chunk executables, dispatch
+        nothing); ``warm="buckets"`` additionally solves one throwaway
+        micro-round per size bucket, so the dispatch-time programs
+        (resident chunk selector) are hot for every round shape and the
+        server serves with zero real XLA compiles from the first
+        request."""
+        from ..sweep import precompile, sweep
+
+        if self._worker is not None:
+            raise RuntimeError("server already started")
+        if warm:
+            pt = tuple(v[0] for _, v in self._axes)
+            warm_grid = [pt] * int(self.cfg["chunk_size"])
+            precompile(self._base_design, self._axes, self._sea_states,
+                       n_iter=self._n_iter, wind=self._wind,
+                       devices=self._devices, device=self._device,
+                       health=self._health,
+                       chunk_size=self.cfg["chunk_size"], grid=warm_grid)
+            if warm == "buckets":
+                top = self._bucket(int(self.cfg["max_round_designs"]))
+                b = int(self.cfg["chunk_size"])
+                while True:
+                    sweep(self._base_design, self._axes, self._sea_states,
+                          n_iter=self._n_iter, wind=self._wind,
+                          devices=self._devices, device=self._device,
+                          health=self._health,
+                          chunk_size=self.cfg["chunk_size"],
+                          grid=[pt] * b)
+                    if b >= top:
+                        break
+                    b *= 2
+        self._t_started = time.monotonic()
+        self._worker = threading.Thread(
+            target=self._serve_loop, name="raft-tpu-serve", daemon=True)
+        self._worker.start()
+        chaos_mod.register_preempt_hook(self._preempt_drain)
+        return self
+
+    def close(self, drain=True, timeout=60.0):
+        """Stop the server.
+
+        ``drain=True`` finishes and delivers the in-flight round, then
+        checkpoints still-queued requests to ``cfg['drain_path']`` (when
+        set) and fails them typed (``RequestRejected('closed')``).
+        ``drain=False`` abandons the queue the same way without waiting
+        for the current round.
+        """
+        with self._lock:
+            if self._closing:
+                self._closed.wait(timeout)
+                return
+            self._closing = True
+            self._lock.notify_all()
+        if self._worker is not None:
+            self._worker.join(timeout if drain else 1.0)
+        self._drain_queue(checkpoint=True)
+        chaos_mod.unregister_preempt_hook(self._preempt_drain)
+        self._closed.set()
+        self._run.finish(ok=True, counts=dict(self._counts))
+        self._run.close()
+
+    def __enter__(self):
+        return self
+
+    def __exit__(self, *exc):
+        self.close()
+        return False
+
+    # -- submission API ---------------------------------------------------
+
+    def submit(self, points, *, tenant="default", priority=None,
+               deadline_s=None, _synthetic=False) -> Ticket:
+        """Admit one request (a list of design-point tuples).
+
+        Raises the typed admission errors documented on the class;
+        returns a :class:`Ticket` whose ``result()`` blocks for the
+        coalesced solve.
+        """
+        points = [tuple(pt) for pt in points]
+        n_ax = len(self._axes)
+        for pt in points:
+            if len(pt) != n_ax:
+                raise RequestRejected(
+                    "too_large", f"point has {len(pt)} values for "
+                                 f"{n_ax} axes")
+        priority = (self.cfg["default_priority"] if priority is None
+                    else int(priority))
+        if deadline_s is None:
+            deadline_s = self.cfg["default_deadline_s"]
+        deadline_s = float(deadline_s)
+        rid = f"req-{next(self._req_seq):06d}"
+        fps = [point_fingerprint(pt) for pt in points]
+
+        reason = detail = None
+        if not points or len(points) > self.cfg["max_request_designs"]:
+            reason, detail = "too_large", (
+                f"{len(points)} designs (limit "
+                f"{self.cfg['max_request_designs']})")
+        elif deadline_s < 0:
+            reason, detail = "deadline", "deadline already expired"
+        else:
+            tripped = [fp for fp in fps if not self._breaker.allows(fp)]
+            if tripped:
+                reason, detail = "breaker", (
+                    f"{len(tripped)} design(s) circuit-broken "
+                    f"(first: {tripped[0]})")
+        if reason is None:
+            with self._lock:
+                if self._closing:
+                    reason = "closed"
+                elif (self._pending_designs + len(points)
+                      > self.cfg["max_pending_designs"]):
+                    reason, detail = "saturated", (
+                        f"{self._pending_designs} designs queued (bound "
+                        f"{self.cfg['max_pending_designs']})")
+                else:
+                    req = _Request(
+                        rid, str(tenant), points, fps, priority,
+                        (time.monotonic() + deadline_s
+                         if deadline_s > 0 else None),
+                        deadline_s, next(self._req_seq),
+                        self.cfg["retry_rounds"], synthetic=_synthetic)
+                    self._pending.append(req)
+                    self._pending_designs += len(points)
+                    self._counts["accepted"] += 1
+                    self._lock.notify_all()
+        if reason is not None:
+            self._counts["rejected"] += 1
+            self._run.emit("request_reject", request=rid, reason=reason,
+                           tenant=str(tenant), designs=len(points))
+            if reason == "saturated":
+                raise ServerSaturated(detail)
+            raise RequestRejected(reason, detail or "")
+        self._run.emit("request_accept", request=rid, tenant=str(tenant),
+                       designs=len(points), priority=priority,
+                       deadline_s=deadline_s or None)
+        return Ticket(self, req)
+
+    def solve(self, points, timeout=None, **kw) -> dict:
+        """``submit`` + ``result`` in one call (the blocking API)."""
+        return self.submit(points, **kw).result(timeout)
+
+    def inject_chaos(self, spec) -> None:
+        """Arm ``spec`` (a sweep-level chaos spec string) for the NEXT
+        round only — the deterministic way to drive ``device_lost`` /
+        ``preempt`` through a serving process."""
+        with self._lock:
+            self._next_chaos = spec
+
+    # -- introspection ----------------------------------------------------
+
+    def stats(self) -> dict:
+        """Live counters + latency percentiles (the serve_check /
+        history-store payload)."""
+        with self._lock:
+            counts = dict(self._counts)
+            queued = len([r for r in self._pending if not r.cancelled])
+        elapsed = (time.monotonic() - self._t_started
+                   if self._t_started else 0.0)
+        p50 = self._latency.percentile(50)
+        p99 = self._latency.percentile(99)
+        return {
+            **counts,
+            "queued": queued,
+            "elapsed_s": round(elapsed, 3),
+            "requests_per_s": (round(counts["completed"] / elapsed, 3)
+                               if elapsed > 0 else None),
+            "p50_s": None if p50 is None else round(p50, 6),
+            "p99_s": None if p99 is None else round(p99, 6),
+            "breaker_open": self._breaker.tripped(),
+        }
+
+    # -- internal: cancellation / failure delivery ------------------------
+
+    def _cancel(self, req) -> bool:
+        with self._lock:
+            if req.event.is_set() or req.cancelled:
+                return False
+            req.cancelled = True
+            self._lock.notify_all()
+        # delivery happens at the next round composition (queued) or at
+        # the in-flight round's delivery (dispatched); either way the
+        # caller unblocks with the typed error now
+        self._deliver_error(req, RequestCancelled(
+            f"request {req.id} cancelled"), "request_cancel")
+        return True
+
+    def _deliver_error(self, req, err, event):
+        already = req.event.is_set()
+        if already:
+            return
+        req.error = err
+        req.event.set()
+        counter = {"request_cancel": "cancelled",
+                   "request_deadline": "deadline"}.get(event)
+        with self._lock:
+            if counter:
+                self._counts[counter] += 1
+            elif event == "request_done":
+                self._counts["failed"] += 1
+        if event == "request_done":
+            self._run.emit("request_done", request=req.id, ok=False,
+                           tenant=req.tenant,
+                           error=f"{type(err).__name__}: {err}")
+        else:
+            kw = {"deadline_s": req.deadline_s} \
+                if event == "request_deadline" else {}
+            self._run.emit(event, request=req.id, tenant=req.tenant, **kw)
+
+    def _deliver_result(self, req, result):
+        if req.event.is_set():
+            return
+        seconds = time.monotonic() - req.t_accept
+        req.result = result
+        delay = None
+        if self._plan is not None:
+            rule = self._plan.fires("slow_client", key=req.seq)
+            if rule is not None:
+                delay = rule.secs
+        if delay:
+            # a slow reader stalls only its own delivery: the unblock
+            # runs on a timer thread, never the round worker
+            threading.Timer(delay, req.event.set).start()
+        else:
+            req.event.set()
+        with self._lock:
+            self._counts["completed"] += 1
+        self._latency.observe(seconds)
+        self._run.emit("request_done", request=req.id, ok=True,
+                       tenant=req.tenant, seconds=round(seconds, 6))
+
+    # -- internal: drain --------------------------------------------------
+
+    def _preempt_drain(self) -> bool:
+        """Chaos ``preempt`` routing for a resident server: checkpoint
+        the queue, emit the drill, KEEP serving (return True = handled,
+        no SIGTERM is delivered)."""
+        path = self._checkpoint_pending()
+        with self._lock:
+            self._counts["drains"] += 1
+            queued = len(self._pending)
+        self._run.emit("preempt", signal="drill", drained=queued,
+                       checkpoint=path, resident=True)
+        obs_log.warn(
+            _LOG, "serve: preempt drill — queue checkpointed "
+                  f"({queued} request(s)); still serving", RuntimeWarning)
+        return True
+
+    def _checkpoint_pending(self):
+        """Write still-queued request specs to the resumable drain
+        JSON; returns the path (None when unconfigured)."""
+        path = self.cfg["drain_path"]
+        if not path:
+            return None
+        with self._lock:
+            specs = [{"tenant": r.tenant,
+                      "points": [list(pt) for pt in r.points],
+                      "priority": r.priority,
+                      "deadline_s": r.deadline_s or None}
+                     for r in self._pending
+                     if not r.cancelled and not r.synthetic]
+        tmp = f"{path}.tmp"
+        with open(tmp, "w", encoding="utf-8") as fh:
+            json.dump({"requests": specs}, fh)
+        os.replace(tmp, path)
+        return path
+
+    def resume_pending(self, path=None) -> int:
+        """Resubmit requests from a drain checkpoint; returns how many
+        were re-admitted (admission control applies as usual)."""
+        path = path or self.cfg["drain_path"]
+        if not path or not os.path.exists(path):
+            return 0
+        with open(path, encoding="utf-8") as fh:
+            specs = json.load(fh).get("requests", [])
+        n = 0
+        for spec in specs:
+            try:
+                self.submit(spec["points"], tenant=spec.get("tenant",
+                                                            "default"),
+                            priority=spec.get("priority"),
+                            deadline_s=spec.get("deadline_s"))
+                n += 1
+            except RequestRejected:
+                continue
+        return n
+
+    def _drain_queue(self, checkpoint):
+        if checkpoint:
+            self._checkpoint_pending()
+        with self._lock:
+            leftover, self._pending = self._pending, []
+            self._pending_designs = 0
+        for req in leftover:
+            if not req.cancelled:
+                self._deliver_error(
+                    req, RequestRejected("closed", "server closed"),
+                    "request_done")
+
+    # -- internal: the round worker ---------------------------------------
+
+    def _serve_loop(self):
+        while True:
+            with self._lock:
+                while not self._closing and not any(
+                        not r.cancelled for r in self._pending):
+                    self._lock.wait(timeout=0.5)
+                if self._closing:
+                    return
+            batch = self._compose_round()
+            if batch:
+                self._run_round(batch)
+
+    def _fire_request_chaos(self):
+        """req_flood / cancel_storm at round composition."""
+        if self._plan is None:
+            return
+        rule = self._plan.fires("req_flood", key=self._round_no)
+        if rule is not None:
+            flood_pt = tuple(v[0] for _, v in self._axes)
+            shed = 0
+            tickets = []
+            for _ in range(rule.count):
+                try:
+                    tickets.append(self.submit(
+                        [flood_pt], tenant="_chaos", _synthetic=True))
+                except RequestRejected:
+                    shed += 1
+            # the flood's job is driving admission control, not burning
+            # device time: cancel what got in
+            for t in tickets:
+                t.cancel()
+            _LOG.info("chaos req_flood: %d injected, %d shed",
+                      len(tickets), shed)
+        rule = self._plan.fires("cancel_storm", key=self._round_no)
+        if rule is not None:
+            with self._lock:
+                victims = [r for r in self._pending
+                           if not r.cancelled][:rule.count]
+            for r in victims:
+                self._cancel(r)
+
+    def _compose_round(self) -> list:
+        """Pick the next round's members: drop cancelled, expire
+        overdue, order by (priority, fair tenant round-robin), pack to
+        the round budget."""
+        self._fire_request_chaos()
+        now = time.monotonic()
+        expired, members = [], []
+        with self._lock:
+            keep = []
+            for r in self._pending:
+                if r.cancelled or r.event.is_set():
+                    self._pending_designs -= len(r.points)
+                elif r.expired(now):
+                    expired.append(r)
+                    self._pending_designs -= len(r.points)
+                else:
+                    keep.append(r)
+            # priority first, then fair round-robin over tenants inside
+            # each class: take one request per tenant per cycle, tenants
+            # cycled in order of their oldest queued request
+            keep.sort(key=lambda r: (r.priority, r.seq))
+            budget = self.cfg["max_round_designs"]
+            by_tenant: dict = {}
+            for r in keep:
+                by_tenant.setdefault((r.priority, r.tenant), []).append(r)
+            classes: dict = {}
+            for (prio, tenant), rs in by_tenant.items():
+                classes.setdefault(prio, []).append((rs[0].seq, tenant, rs))
+            used = 0
+            for prio in sorted(classes):
+                lanes = [list(rs) for _, _, rs in sorted(classes[prio])]
+                while lanes:
+                    progressed = False
+                    for lane in list(lanes):
+                        if not lane:
+                            lanes.remove(lane)
+                            continue
+                        r = lane[0]
+                        if used + len(r.points) > budget:
+                            lanes.remove(lane)
+                            continue
+                        lane.pop(0)
+                        members.append(r)
+                        used += len(r.points)
+                        progressed = True
+                    if not progressed:
+                        break
+            for r in members:
+                keep.remove(r)
+                self._pending_designs -= len(r.points)
+            self._pending = keep
+        for r in expired:
+            self._deliver_error(r, DeadlineExceeded(
+                f"request {r.id} missed its {r.deadline_s:.3f}s deadline "
+                "before dispatch"), "request_deadline")
+        return members
+
+    def _requeue(self, reqs):
+        with self._lock:
+            for r in reqs:
+                self._pending.insert(0, r)
+                self._pending_designs += len(r.points)
+            self._lock.notify_all()
+
+    def _run_round(self, members):
+        from ..sweep import sweep
+
+        self._round_no += 1
+        round_no = self._round_no
+        real = [pt for r in members for pt in r.points]
+        grid = self._warm_pad(real)
+        with self._lock:
+            chaos_spec, self._next_chaos = self._next_chaos, None
+            self._counts["rounds"] += 1
+            self._counts["coalesced_designs"] += len(real)
+        self._run.emit("serve_round", round=round_no,
+                       requests=len(members), designs=len(real),
+                       padded=len(grid))
+
+        def _solve():
+            return sweep(self._base_design, self._axes, self._sea_states,
+                         n_iter=self._n_iter, wind=self._wind,
+                         devices=self._devices, device=self._device,
+                         health=self._health,
+                         chunk_size=self.cfg["chunk_size"],
+                         chaos=chaos_spec if chaos_spec else False,
+                         grid=grid)
+
+        deadlines = [r.deadline for r in members if r.deadline is not None]
+        try:
+            if deadlines:
+                budget = (max(deadlines) - time.monotonic()
+                          + self.cfg["deadline_grace_s"])
+                out = call_with_deadline(
+                    _solve, max(budget, 0.001),
+                    what=f"serve round {round_no}")
+            else:
+                out = _solve()
+        except BaseException as err:  # noqa: BLE001 - typed fan-out below
+            self._fail_round(members, err)
+            return
+        self._deliver_round(members, out)
+
+    def _fail_round(self, members, err):
+        now = time.monotonic()
+        retry = []
+        for r in members:
+            if r.cancelled or r.event.is_set():
+                continue
+            if r.expired(now):
+                self._deliver_error(r, DeadlineExceeded(
+                    f"request {r.id} missed its deadline "
+                    f"({type(err).__name__} in round)"),
+                    "request_deadline")
+                continue
+            if r.retries_left > 0:
+                r.retries_left -= 1
+                retry.append(r)
+            else:
+                self._deliver_error(r, RequestFailed(
+                    f"request {r.id} failed after retries: "
+                    f"{type(err).__name__}: {err}"), "request_done")
+        if retry:
+            _LOG.warning(
+                "serve: round failed (%s: %s); requeueing %d request(s)",
+                type(err).__name__, err, len(retry))
+            self._requeue(retry)
+
+    def _deliver_round(self, members, out):
+        offset = 0
+        for r in members:
+            n = len(r.points)
+            sl = slice(offset, offset + n)
+            offset += n
+            if r.cancelled or r.event.is_set():
+                continue
+            if r.expired():
+                self._deliver_error(r, DeadlineExceeded(
+                    f"request {r.id} completed past its "
+                    f"{r.deadline_s:.3f}s deadline"), "request_deadline")
+                continue
+            status_rows = np.asarray(out["status"][sl])
+            for fp, st in zip(r.fps, status_rows):
+                if int(st) == STATUS_QUARANTINED:
+                    self._breaker.record_failure(fp)
+                else:
+                    self._breaker.record_success(fp)
+            result = {"grid": list(out["grid"][sl])}
+            for key in _RESULT_KEYS:
+                result[key] = np.asarray(out[key])[sl].copy()
+            result["health"] = {
+                k: np.asarray(v)[sl].copy()
+                for k, v in out["health"].items()}
+            self._deliver_result(r, result)

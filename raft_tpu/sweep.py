@@ -25,6 +25,7 @@ from __future__ import annotations
 import copy
 import functools
 import itertools
+import threading
 import time
 import warnings
 import zipfile
@@ -84,6 +85,16 @@ _CHUNK_EXEC_HOOK = None
 # hits a stale entry.
 _TEMPLATE_MEMO: dict = {}
 _TEMPLATE_MEMO_MAX = 4
+# Concurrent sweep() entry (the serve layer, DOE drivers with worker
+# threads) mutates the memo from several threads: entry creation +
+# eviction and the nested stack/resident/bem/jitted sub-cache writes all
+# happen under this lock.  Reads stay lock-free (dict.get is atomic
+# under the GIL and entries are never mutated in place once published —
+# sub-caches only grow).  Contract: concurrent WARM entry is
+# compile-free and bit-identical to sequential; concurrent COLD entry
+# on the same design may build the executables redundantly (last memo
+# write wins, both results correct) — warm once, then fan out.
+_MEMO_LOCK = threading.Lock()
 
 
 def _design_hash(base_design):
@@ -246,7 +257,7 @@ def _sweep_signature(base_design, axes, combos, sea_states, n_iter, wind):
 
 def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
           checkpoint=None, chunk_size=256, wind=None, devices=None,
-          health=None, flightrec=None, chaos=None):
+          health=None, flightrec=None, chaos=None, grid=None):
     """Run a factorial design sweep.
 
     Parameters
@@ -255,6 +266,17 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
         RAFT design dict (strip-theory configuration).
     axes : list of (path_or_callable, values)
         Design-variable axes; full factorial product is evaluated.
+    grid : list of value tuples, optional
+        Explicit design points — one value per axis in ``axes`` — run
+        INSTEAD of the factorial product.  This is the coalescing entry
+        point for :mod:`raft_tpu.serve`: many small requests concatenate
+        their points into one grid so they share the same fixed-shape
+        padded chunks, and results come back in grid order (row ``i`` of
+        every result array is ``grid[i]``).  The executables, template
+        memo, stack memo, and checkpoint signature all key off the
+        actual point list, so a grid sweep is bit-identical to the same
+        points run factorially (row independence: chunk programs are
+        vmapped with padding rows, so cohabiting points never interact).
     sea_states : list of (Hs, Tp) or (Hs, Tp, heading_deg)
         Wave cases solved (batched) for every design variant.
     devices : sequence of jax devices, optional
@@ -371,9 +393,12 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
     devices, mesh_shape = resolve_mesh_devices(devices, device)
     run = obs_ledger.NULL_RUN
     if obs_ledger.observing():
-        n_designs = 1
-        for _, v in axes:
-            n_designs *= len(v)
+        if grid is not None:
+            n_designs = len(grid)
+        else:
+            n_designs = 1
+            for _, v in axes:
+                n_designs *= len(v)
         run = obs_ledger.start_run(
             "sweep",
             fingerprint={"design": _design_hash(base_design)[:16],
@@ -393,7 +418,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
                                   chunk_size=chunk_size, wind=wind,
                                   devices=devices, mesh_shape=mesh_shape,
                                   health=health, flightrec=flightrec,
-                                  run=run, chaos=chaos,
+                                  run=run, chaos=chaos, grid=grid,
                                   _resume_state=state)
                 break
             except elastic.RemeshRequired as rq:
@@ -433,7 +458,7 @@ def sweep(base_design, axes, sea_states, n_iter=15, device=None, display=0,
 
 def precompile(base_design, axes, sea_states, n_iter=15, device=None,
                display=0, chunk_size=256, wind=None, devices=None,
-               health=None, flightrec=None):
+               health=None, flightrec=None, grid=None):
     """Warm up the sweep executables without dispatching any chunk.
 
     Runs :func:`sweep`'s plan phase exactly — template model, variant
@@ -464,9 +489,12 @@ def precompile(base_design, axes, sea_states, n_iter=15, device=None,
     devices, mesh_shape = resolve_mesh_devices(devices, device)
     run = obs_ledger.NULL_RUN
     if obs_ledger.observing():
-        n_designs = 1
-        for _, v in axes:
-            n_designs *= len(v)
+        if grid is not None:
+            n_designs = len(grid)
+        else:
+            n_designs = 1
+            for _, v in axes:
+                n_designs *= len(v)
         run = obs_ledger.start_run(
             "precompile",
             fingerprint={"design": _design_hash(base_design)[:16],
@@ -481,7 +509,8 @@ def precompile(base_design, axes, sea_states, n_iter=15, device=None,
                           device=device, display=display, checkpoint=None,
                           chunk_size=chunk_size, wind=wind, devices=devices,
                           mesh_shape=mesh_shape, health=health,
-                          flightrec=flightrec, run=run, compile_only=True)
+                          flightrec=flightrec, run=run, grid=grid,
+                          compile_only=True)
         run.finish(ok=True)
         return out
     except BaseException as e:
@@ -494,7 +523,7 @@ def precompile(base_design, axes, sea_states, n_iter=15, device=None,
 def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                 checkpoint, chunk_size, wind, devices, health, run,
                 flightrec=None, mesh_shape=None, compile_only=False,
-                chaos=None, _resume_state=None):
+                chaos=None, grid=None, _resume_state=None):
     """:func:`sweep` body; ``run`` is the active ledger run (NULL_RUN
     when telemetry is off — every ``run.emit`` is then a no-op and all
     byte/stat collection is gated behind ``run.enabled``).
@@ -507,7 +536,21 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
     from .parallel.case_solve import make_parametric_solver
     from .parallel.design_batch import _vkey, make_batch_compiler, rna_params_for
 
-    combos = list(itertools.product(*[v for _, v in axes]))
+    if grid is not None:
+        # explicit design points (the serve-layer coalescing path):
+        # every tuple supplies one value per axis, evaluated in grid
+        # order instead of the factorial product
+        combos = [tuple(pt) for pt in grid]
+        if not combos:
+            raise ValueError("grid must contain at least one design point")
+        n_ax = len(axes)
+        for pt in combos:
+            if len(pt) != n_ax:
+                raise ValueError(
+                    f"grid point has {len(pt)} values for {n_ax} axes: "
+                    f"{pt!r}")
+    else:
+        combos = list(itertools.product(*[v for _, v in axes]))
     n_designs = len(combos)
     n_cases = len(sea_states)
     if wind is not None and len(wind) != n_cases:
@@ -1209,15 +1252,16 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         # flight (the compiled pair lands in it at the join); creating it
         # here lets the stack/resident memos below attach to it on the
         # SAME cold sweep instead of only after a warm repeat
-        entry = _TEMPLATE_MEMO.get(memo_key)
-        if (entry is None or entry["treedef"] != treedef
-                or entry.get("spec") != spec):
-            entry = {"model": model, "fowt": fowt, "compile_one": compile_one,
-                     "static": static, "treedef": treedef, "spec": spec,
-                     "jitted": {}}
-            _TEMPLATE_MEMO[memo_key] = entry
-        while len(_TEMPLATE_MEMO) > _TEMPLATE_MEMO_MAX:
-            _TEMPLATE_MEMO.pop(next(iter(_TEMPLATE_MEMO)))
+        with _MEMO_LOCK:
+            entry = _TEMPLATE_MEMO.get(memo_key)
+            if (entry is None or entry["treedef"] != treedef
+                    or entry.get("spec") != spec):
+                entry = {"model": model, "fowt": fowt,
+                         "compile_one": compile_one, "static": static,
+                         "treedef": treedef, "spec": spec, "jitted": {}}
+                _TEMPLATE_MEMO[memo_key] = entry
+            while len(_TEMPLATE_MEMO) > _TEMPLATE_MEMO_MAX:
+                _TEMPLATE_MEMO.pop(next(iter(_TEMPLATE_MEMO)))
 
         def _join_compiles():
             """First-dispatch join on the background compile pipeline:
@@ -1268,9 +1312,10 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                         "falling back to inline jit")
                 cA_, cB_ = jA, jB
             jitted = (cA_, cB_)
-            entry = _TEMPLATE_MEMO.get(memo_key)
-            if entry is not None and entry.get("spec") == spec:
-                entry["jitted"][jit_key] = jitted
+            with _MEMO_LOCK:
+                entry = _TEMPLATE_MEMO.get(memo_key)
+                if entry is not None and entry.get("spec") == spec:
+                    entry["jitted"][jit_key] = jitted
             return jitted
 
         # main thread (overlapped with the compiles above): aero-servo
@@ -1317,13 +1362,14 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         if bem_active and not compile_only:
             bem_key = ((stack_key, bem_heads)
                        if stack_key is not None else None)
-            entry = _TEMPLATE_MEMO.get(memo_key)
             bcache = None
-            if (bem_key is not None and entry is not None
-                    and entry.get("treedef") == treedef
-                    and entry.get("spec") == spec):
-                bcache = entry.setdefault("bem", {})
-                bem_host = bcache.get(bem_key)
+            with _MEMO_LOCK:
+                entry = _TEMPLATE_MEMO.get(memo_key)
+                if (bem_key is not None and entry is not None
+                        and entry.get("treedef") == treedef
+                        and entry.get("spec") == spec):
+                    bcache = entry.setdefault("bem", {})
+                    bem_host = bcache.get(bem_key)
             if bem_host is None:
                 from .hydro.bem_batch import solve_design_batch
                 bdt = np.dtype(zetas.dtype)
@@ -1340,9 +1386,10 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                          headings=len(bem_heads),
                          seconds=round(time.perf_counter() - t0, 6))
                 if bcache is not None:
-                    while len(bcache) >= 2:
-                        bcache.pop(next(iter(bcache)))
-                    bcache[bem_key] = bem_host
+                    with _MEMO_LOCK:
+                        while len(bcache) >= 2:
+                            bcache.pop(next(iter(bcache)))
+                        bcache[bem_key] = bem_host
             else:
                 run.emit("bem_precompute", cache="hit",
                          designs=n_designs, headings=len(bem_heads))
@@ -1369,12 +1416,13 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         # chunk loop — everything in between runs while XLA compiles
         cA = cB = None
         if cached_stack is None and stack_key is not None:
-            entry = _TEMPLATE_MEMO.get(memo_key)
-            if entry is not None and entry.get("treedef") == treedef:
-                stacks = entry.setdefault("stacks", {})
-                while len(stacks) >= 4:
-                    stacks.pop(next(iter(stacks)))
-                stacks[stack_key] = (stacked, treedef, aero_axes)
+            with _MEMO_LOCK:
+                entry = _TEMPLATE_MEMO.get(memo_key)
+                if entry is not None and entry.get("treedef") == treedef:
+                    stacks = entry.setdefault("stacks", {})
+                    while len(stacks) >= 4:
+                        stacks.pop(next(iter(stacks)))
+                    stacks[stack_key] = (stacked, treedef, aero_axes)
 
         # input-validity premark: designs whose stacked leaves carry
         # NaN/Inf are flagged NAN even if the solve happens to return
@@ -1397,13 +1445,14 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
         if ecfg["resident"]:
             rkey = ((stack_key, place_sig, chunk_size)
                     if stack_key is not None else None)
-            entry = _TEMPLATE_MEMO.get(memo_key)
             rcache = None
-            if (rkey is not None and entry is not None
-                    and entry.get("treedef") == treedef
-                    and entry.get("spec") == spec):
-                rcache = entry.setdefault("resident", {})
-                resident = rcache.get(rkey)
+            with _MEMO_LOCK:
+                entry = _TEMPLATE_MEMO.get(memo_key)
+                if (rkey is not None and entry is not None
+                        and entry.get("treedef") == treedef
+                        and entry.get("spec") == spec):
+                    rcache = entry.setdefault("resident", {})
+                    resident = rcache.get(rkey)
             if resident is None:
                 upload_err = None
                 try:
@@ -1452,9 +1501,10 @@ def _sweep_impl(base_design, axes, sea_states, *, n_iter, device, display,
                     obs_ledger.emit_device_memory(run, device=devices,
                                                   what="resident_upload")
                 if resident is not None and rcache is not None:
-                    while len(rcache) >= 2:
-                        rcache.pop(next(iter(rcache)))
-                    rcache[rkey] = resident
+                    with _MEMO_LOCK:
+                        while len(rcache) >= 2:
+                            rcache.pop(next(iter(rcache)))
+                        rcache[rkey] = resident
 
         # static IR audit of the chunk-gather selector (graftaudit):
         # lowers the selector over the real resident batch — tracing
